@@ -1,0 +1,382 @@
+"""The Raft tick kernel: one pure, vmap'able state transition per simulated tick.
+
+This is the TPU-native re-expression of the reference's `wait` event loop
+(core.clj:176-195): deliver -> handle -> collect. Where the reference blocks on
+`alts!!` over [inbound requests, rpc responses, timeout] and dispatches ONE message per
+loop iteration, the array kernel delivers the whole [N, N] mailbox at once and folds
+every node's inbound edges through vectorized handler logic -- `jnp.where` lattices
+instead of `cond` cascades, no Python control flow, static shapes throughout.
+
+Handler provenance (all spec-correct; the reference's deviations are catalogued in
+SURVEY.md section 2.3 and deliberately NOT carried):
+
+  phase 1  term adoption         <- scattered `(> term current-term)` checks
+                                    (core.clj:97, 129-130, 144-145); unlike the
+                                    reference, RequestVote also adopts terms (bug 2.3.2)
+  phase 2  vote requests         <- request-vote-handler (core.clj:91-103), with the
+                                    spec up-to-date check instead of compare-prev?
+  phase 3  append requests       <- append-entries-handler (core.clj:105-123), with
+                                    spec conflict-truncate-then-append instead of the
+                                    remove-from! bug (2.3.7) and real leader-commit
+                                    handling instead of apply-everything (2.3.6)
+  phase 4  responses             <- vote-response-handler (core.clj:125-139) and
+                                    append-response-handler (core.clj:141-149), with
+                                    next-index = match+1 (bug 2.3.10)
+  phase 5  leader commit         <- absent in the reference (bug 2.3.8): quorum-th
+                                    largest match index, current-term restriction
+  phase 6  client injection      <- client-set-handler's leader branch (core.clj:156-160)
+  phase 7  timers                <- generate-timeout + the nil dispatch arm
+                                    (core.clj:162-174, 193-195); election timers reset
+                                    only on vote grant / valid AppendEntries, not on
+                                    every message (bug 2.3.11)
+  phase 8  outbox                <- request-vote-rpc / append-entries-rpc
+                                    (core.clj:48-67) writing the next tick's mailbox
+  phase 9  invariants + metrics  <- absent in the reference; north-star requirement
+
+Everything is written for ONE cluster (shapes [N], [N, N], [N, CAP]); `jax.vmap` lifts
+to [batch, ...] and `lax.scan` (sim/scan.py) rolls ticks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu.ops import log_ops
+from raft_sim_tpu.types import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    NIL,
+    REQ_APPEND,
+    REQ_VOTE,
+    RESP_APPEND,
+    RESP_VOTE,
+    ClusterState,
+    Mailbox,
+    StepInfo,
+    StepInputs,
+)
+from raft_sim_tpu.utils.config import RaftConfig
+
+
+def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
+    """Advance one cluster by one tick. Pure; jit/vmap/scan-safe."""
+    n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
+    mb = s.mailbox
+    ids = jnp.arange(n, dtype=jnp.int32)
+    eye = jnp.eye(n, dtype=bool)
+    src_ids = jnp.broadcast_to(ids[None, :], (n, n))  # [dst, src] -> src id
+
+    # ---- phase 0: delivery -------------------------------------------------------
+    # The fault mask is the TPU-native form of the reference's silently-dropped HTTP
+    # call (client.clj:38-40): a zeroed entry in the delivery mask.
+    deliver = inp.deliver_mask & ~eye
+    req_in = deliver & (mb.req_type != 0)  # [dst, src]
+    resp_in = deliver & (mb.resp_type != 0)
+
+    # ---- phase 1: term adoption --------------------------------------------------
+    # Spec: any RPC (request or response) with term T > currentTerm -> set
+    # currentTerm = T, convert to follower. The reference does this for responses
+    # (core.clj:129-130, 144-145) but not vote requests (bug 2.3.2).
+    in_term = jnp.maximum(
+        jnp.max(jnp.where(req_in, mb.req_term, 0), axis=1),
+        jnp.max(jnp.where(resp_in, mb.resp_term, 0), axis=1),
+    )  # [N]
+    saw_higher = in_term > s.term
+    term = jnp.maximum(s.term, in_term)
+    role = jnp.where(saw_higher, FOLLOWER, s.role)
+    voted_for = jnp.where(saw_higher, NIL, s.voted_for)
+    leader_id = jnp.where(saw_higher, NIL, s.leader_id)
+    votes = s.votes & ~saw_higher[:, None]
+
+    my_last_idx, my_last_term = log_ops.last_index_term(s.log_term, s.log_len)
+
+    # ---- phase 2: RequestVote requests (request-vote-handler, core.clj:91-103) ----
+    is_rv = req_in & (mb.req_type == REQ_VOTE)
+    cur_rv = is_rv & (mb.req_term == term[:, None])  # stale-term requests are denied
+    # Spec 5.4.1 up-to-date check (the reference's compare-prev? log.clj:55-59 compares
+    # against the commit index and whole entry maps -- bugs 2.3.3/2.3.4).
+    up_to_date = (mb.req_prev_term > my_last_term[:, None]) | (
+        (mb.req_prev_term == my_last_term[:, None])
+        & (mb.req_prev_index >= my_last_idx[:, None])
+    )
+    can_grant = cur_rv & up_to_date
+    # At most one grant per node per tick: the lowest eligible candidate id wins the
+    # race (the reference serializes naturally, one message per wait iteration).
+    lowest = jnp.min(jnp.where(can_grant, src_ids, n), axis=1)  # [N], n = none
+    grant = jnp.where(
+        (voted_for != NIL)[:, None],
+        can_grant & (src_ids == voted_for[:, None]),  # idempotent re-grant
+        can_grant & (src_ids == lowest[:, None]),
+    )
+    granted_any = jnp.any(grant, axis=1)
+    voted_for = jnp.where((voted_for == NIL) & granted_any, lowest, voted_for)
+    # Every delivered RV gets a response carrying our (possibly just-adopted) term.
+    vr_out = is_rv  # [dst, src] -- response to src
+    vr_granted = grant
+
+    # ---- phase 3: AppendEntries requests (append-entries-handler, core.clj:105-123) --
+    is_ae = req_in & (mb.req_type == REQ_APPEND)
+    cur_ae = is_ae & (mb.req_term == term[:, None])
+    # Election safety gives at most one leader per term, so at most one current-term AE
+    # sender exists; pick the lowest id defensively (ties indicate a safety violation,
+    # which phase 9 flags).
+    ae_src = jnp.min(jnp.where(cur_ae, src_ids, n), axis=1)  # [N]
+    has_ae = ae_src < n
+    sel = cur_ae & (src_ids == ae_src[:, None])  # one-hot [dst, src]
+
+    pick = lambda f: jnp.sum(jnp.where(sel, f, 0), axis=1)  # [N]
+    prev_i = pick(mb.req_prev_index)
+    prev_t = pick(mb.req_prev_term)
+    lcommit = pick(mb.req_commit)
+    n_ent = pick(mb.req_n_ent)
+    sel_idx = jnp.minimum(ae_src, n - 1)
+    ent_term_in = jnp.take_along_axis(mb.req_ent_term, sel_idx[:, None, None], axis=1)[:, 0]
+    ent_val_in = jnp.take_along_axis(mb.req_ent_val, sel_idx[:, None, None], axis=1)[:, 0]
+
+    # A valid AE from the current term makes candidates step down and identifies the
+    # leader (core.clj:121-123, minus the :follwer typo, bug 2.3.1).
+    role = jnp.where(has_ae & (role == CANDIDATE), FOLLOWER, role)
+    leader_id = jnp.where(has_ae, ae_src, leader_id)
+
+    # Consistency check (spec 5.3; reference compare-prev? has bugs 2.3.4/2.3.5).
+    prev_stored_term = log_ops.term_at(s.log_term, prev_i)
+    consistent = (prev_i == 0) | ((prev_i <= s.log_len) & (prev_stored_term == prev_t))
+    ae_ok = has_ae & consistent
+
+    # Conflict scan over the shipped window: first mismatching entry truncates the rest
+    # of the log; matching prefixes are never truncated (spec 5.3 "delete the existing
+    # entry and all that follow it").
+    ks = jnp.arange(e, dtype=jnp.int32)
+    gidx0 = prev_i[:, None] + ks[None, :]  # [N, E] 0-based slots
+    in_ent = ks[None, :] < n_ent[:, None]
+    exists = gidx0 < s.log_len[:, None]
+    stored = log_ops.window(s.log_term, prev_i, e)  # [N, E]
+    mismatch = in_ent & exists & (stored != ent_term_in)
+    any_mismatch = jnp.any(mismatch, axis=1)
+    appended_len = jnp.minimum(prev_i + n_ent, cap)
+    new_len = jnp.where(
+        any_mismatch, appended_len, jnp.maximum(s.log_len, appended_len)
+    )
+    log_len = jnp.where(ae_ok, new_len, s.log_len)
+    wmask = ae_ok[:, None] & in_ent
+    log_term_arr = log_ops.write_window(s.log_term, prev_i, ent_term_in, wmask)
+    log_val_arr = log_ops.write_window(s.log_val, prev_i, ent_val_in, wmask)
+
+    # Follower commit: min(leaderCommit, index of last new entry), monotonic
+    # (the reference's apply-entries! commits everything unconditionally, bug 2.3.6).
+    last_new = jnp.minimum(prev_i + n_ent, log_len)
+    commit = jnp.where(
+        ae_ok,
+        jnp.maximum(s.commit_index, jnp.minimum(lcommit, last_new)),
+        s.commit_index,
+    )
+
+    # Respond to every delivered AE; success only for the selected, consistent one.
+    ar_out = is_ae
+    ar_success = sel & ae_ok[:, None]
+    ar_match = jnp.where(ar_success, last_new[:, None], 0)
+
+    # ---- phase 4: responses ------------------------------------------------------
+    # Vote tally (vote-response-handler core.clj:125-139; dedup via bitmap mirrors the
+    # reference's set, core.clj:133-134).
+    vresp = resp_in & (mb.resp_type == RESP_VOTE)
+    new_votes = (
+        vresp & mb.resp_ok & (mb.resp_term == term[:, None]) & (role == CANDIDATE)[:, None]
+    )
+    votes = votes | new_votes
+    n_votes = jnp.sum(votes, axis=1).astype(jnp.int32)
+    win = (role == CANDIDATE) & (n_votes >= cfg.quorum)
+    role = jnp.where(win, LEADER, role)
+    leader_id = jnp.where(win, ids, leader_id)
+    # Fresh leader bookkeeping (leader-state core.clj:40-42): nextIndex = last log
+    # index + 1, matchIndex = 0.
+    next_index = jnp.where(win[:, None], (log_len + 1)[:, None], s.next_index)
+    match_index = jnp.where(win[:, None], 0, s.match_index)
+
+    # Append responses (append-response-handler core.clj:141-149), leaders only, same
+    # term. Success: match = acked index, next = match+1 (the reference sets next =
+    # log-index, bug 2.3.10); failure: decrement next-index and retry (core.clj:146).
+    aresp = (
+        resp_in
+        & (mb.resp_type == RESP_APPEND)
+        & (role == LEADER)[:, None]
+        & (mb.resp_term == term[:, None])
+    )
+    a_succ = aresp & mb.resp_ok
+    a_fail = aresp & ~mb.resp_ok
+    match_index = jnp.where(a_succ, jnp.maximum(match_index, mb.resp_match), match_index)
+    next_index = jnp.where(
+        a_succ, jnp.maximum(next_index, mb.resp_match + 1), next_index
+    )
+    next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
+
+    # ---- phase 5: leader commit advancement (absent in reference, bug 2.3.8) ------
+    is_leader = role == LEADER
+    match_with_self = jnp.where(eye, log_len[:, None], match_index)  # [N, N]
+    sorted_desc = -jnp.sort(-match_with_self, axis=1)
+    quorum_match = sorted_desc[:, cfg.quorum - 1]  # quorum-th largest match index
+    # Spec 5.4.2: only commit entries from the current term by counting replicas.
+    quorum_term = log_ops.term_at(log_term_arr, quorum_match)
+    commit = jnp.where(
+        is_leader & (quorum_match > commit) & (quorum_term == term),
+        quorum_match,
+        commit,
+    )
+
+    # ---- phase 6: client command injection (client-set-handler core.clj:151-160) --
+    # The simulator's "client" writes straight to the leader; the reference's
+    # redirect-to-leader dance (core.clj:152-155) has no array equivalent because
+    # cluster membership is globally visible here.
+    do_inject = (inp.client_cmd != NIL) & is_leader & (log_len < cap)
+    inj_pos = jnp.where(do_inject, log_len, cap)  # cap = out of bounds -> dropped
+    log_term_arr = log_term_arr.at[ids, inj_pos].set(term, mode="drop")
+    log_val_arr = log_val_arr.at[ids, inj_pos].set(
+        jnp.broadcast_to(inp.client_cmd, (n,)), mode="drop"
+    )
+    log_len = log_len + do_inject
+
+    # ---- phase 7: timers (generate-timeout core.clj:171-174; dispatch :193-195) ----
+    clock = s.clock + inp.skew
+    # Election timer resets ONLY on vote grant or valid current-term AppendEntries (or
+    # stepping down), not on every message (reference bug 2.3.11).
+    reset_election = granted_any | has_ae | saw_higher
+    deadline = jnp.where(reset_election, clock + inp.timeout_draw, s.deadline)
+    deadline = jnp.where(win, clock + cfg.heartbeat_ticks, deadline)
+    expired = clock >= deadline
+
+    # Leader heartbeat (heartbeat-handler core.clj:162-164).
+    heartbeat = expired & is_leader
+    deadline = jnp.where(heartbeat, clock + cfg.heartbeat_ticks, deadline)
+
+    # Follower/candidate timeout -> new election (timeout-handler core.clj:166-169,
+    # follower->candidate core.clj:69-73: term++, vote self).
+    start_election = expired & ~is_leader
+    term = term + start_election
+    role = jnp.where(start_election, CANDIDATE, role)
+    voted_for = jnp.where(start_election, ids, voted_for)
+    leader_id = jnp.where(start_election, NIL, leader_id)
+    votes = jnp.where(start_election[:, None], eye, votes)
+    deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
+
+    # ---- phase 8: outbox ---------------------------------------------------------
+    send_append = win | heartbeat  # fresh leaders heartbeat immediately (core.clj:137-138)
+    new_last_idx, new_last_term = log_ops.last_index_term(log_term_arr, log_len)
+
+    # Requests, built [src, dst] then transposed to the mailbox's [dst, src].
+    rv_edge = start_election[:, None] & ~eye  # request-vote-rpc core.clj:48-54
+    ae_edge = send_append[:, None] & ~eye  # append-entries-rpc core.clj:56-67
+    out_req_type = jnp.where(rv_edge, REQ_VOTE, jnp.where(ae_edge, REQ_APPEND, 0))
+    out_req_term = jnp.broadcast_to(term[:, None], (n, n))
+    # AE slice: prev = nextIndex - 1, window of up to E entries from prev.
+    prev_out = jnp.clip(next_index - 1, 0, log_len[:, None])  # [src, dst]
+    n_out = jnp.clip(log_len[:, None] - prev_out, 0, e)
+    out_prev_term_ae = log_ops.term_at(log_term_arr, prev_out)
+    out_req_prev_index = jnp.where(rv_edge, new_last_idx[:, None], prev_out)
+    out_req_prev_term = jnp.where(rv_edge, new_last_term[:, None], out_prev_term_ae)
+    out_req_commit = jnp.broadcast_to(commit[:, None], (n, n))
+    out_req_n_ent = jnp.where(ae_edge, n_out, 0)
+    out_ent_term = log_ops.window(log_term_arr, prev_out, e)  # [src, dst, E]
+    out_ent_val = log_ops.window(log_val_arr, prev_out, e)
+
+    # Responses: vr_out/ar_out are [dst_of_request, src_of_request]; the response
+    # travels back src<->dst, i.e. a transpose (the reference's resp-chan round trip,
+    # server.clj:59-60 -> client.clj:34-40).
+    out_resp_type = (
+        jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
+    ).T
+    out_resp_term = jnp.broadcast_to(term[:, None], (n, n)).T
+    out_resp_ok = (vr_granted | ar_success).T
+    out_resp_match = ar_match.T
+
+    new_mb = Mailbox(
+        req_type=out_req_type.T,
+        req_term=jnp.where(out_req_type != 0, out_req_term, 0).T,
+        req_prev_index=jnp.where(out_req_type != 0, out_req_prev_index, 0).T,
+        req_prev_term=jnp.where(out_req_type != 0, out_req_prev_term, 0).T,
+        req_commit=jnp.where(ae_edge, out_req_commit, 0).T,
+        req_n_ent=out_req_n_ent.T,
+        req_ent_term=jnp.where(ae_edge[..., None], out_ent_term, 0).swapaxes(0, 1),
+        req_ent_val=jnp.where(ae_edge[..., None], out_ent_val, 0).swapaxes(0, 1),
+        resp_type=out_resp_type,
+        resp_term=jnp.where(out_resp_type != 0, out_resp_term, 0),
+        resp_ok=out_resp_ok,
+        resp_match=out_resp_match,
+    )
+
+    new_state = ClusterState(
+        role=role,
+        term=term,
+        voted_for=voted_for,
+        leader_id=leader_id,
+        votes=votes,
+        next_index=next_index,
+        match_index=match_index,
+        commit_index=commit,
+        log_term=log_term_arr,
+        log_val=log_val_arr,
+        log_len=log_len,
+        clock=clock,
+        deadline=deadline,
+        now=s.now + 1,
+        mailbox=new_mb,
+    )
+
+    info = _step_info(cfg, s, new_state, req_in, resp_in)
+    return new_state, info
+
+
+def _step_info(
+    cfg: RaftConfig,
+    old: ClusterState,
+    new: ClusterState,
+    req_in: jax.Array,
+    resp_in: jax.Array,
+) -> StepInfo:
+    """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
+    n = cfg.n_nodes
+    eye = jnp.eye(n, dtype=bool)
+    is_leader = new.role == LEADER
+    f = jnp.bool_(False)
+
+    if cfg.check_invariants:
+        # Election safety: at most one leader per term (Raft fig. 3).
+        pair_bad = (
+            is_leader[:, None]
+            & is_leader[None, :]
+            & (new.term[:, None] == new.term[None, :])
+            & ~eye
+        )
+        viol_election = jnp.any(pair_bad)
+        # Commit sanity: monotonic and within the log.
+        viol_commit = jnp.any(
+            (new.commit_index < old.commit_index) | (new.commit_index > new.log_len)
+        )
+    else:
+        viol_election = f
+        viol_commit = f
+
+    if cfg.check_log_matching:
+        # Log matching on committed prefixes: any two nodes agree on every entry up to
+        # min(commit_i, commit_j). O(N^2 * CAP) -- gated by config.
+        minc = jnp.minimum(new.commit_index[:, None], new.commit_index[None, :])
+        ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
+        both = ks[None, None, :] < minc[:, :, None]
+        differ = new.log_term[:, None, :] != new.log_term[None, :, :]
+        viol_match = jnp.any(both & differ)
+    else:
+        viol_match = f
+
+    leader = jnp.min(jnp.where(is_leader, jnp.arange(n, dtype=jnp.int32), n))
+    return StepInfo(
+        viol_election_safety=viol_election,
+        viol_commit=viol_commit,
+        viol_log_matching=viol_match,
+        leader=jnp.where(leader < n, leader, NIL).astype(jnp.int32),
+        n_leaders=jnp.sum(is_leader).astype(jnp.int32),
+        max_term=jnp.max(new.term),
+        max_commit=jnp.max(new.commit_index),
+        min_commit=jnp.min(new.commit_index),
+        msgs_delivered=(jnp.sum(req_in) + jnp.sum(resp_in)).astype(jnp.int32),
+    )

@@ -1,0 +1,83 @@
+"""Fixed-capacity replicated-log array ops.
+
+The reference's log is an unbounded Clojure vector in an atom with 1-based indexing
+where index 0 means "no entry" (log.clj:20-23, 33). XLA needs static shapes, so here a
+log is a [N, CAP] term/value array pair plus a [N] length counter; every reference log
+op maps to a masked gather/scatter:
+
+  last-entry (log.clj:47-49)      -> last_index/last_term   (spec-correct: the actual
+                                     last *log* entry; the reference returns the commit
+                                     index instead -- documented bug, SURVEY.md 2.3.3)
+  val-at (log.clj:20-23)          -> term_at (1-based, 0 -> "no entry" sentinel 0)
+  entries-from (log.clj:51-53)    -> window (bounded E-entry slice; the reference ships
+                                     arbitrary suffixes, core.clj:59-67)
+  append-entries!/remove-from!
+  (log.clj:61-64, 78-81)          -> the caller writes via write_window (truncation is
+                                     just a smaller length + overwrite; spec-correct,
+                                     unlike remove-from!'s drop-last bug, SURVEY.md 2.3.7)
+
+All functions are written for a single cluster ([N, CAP] / [N] shapes) and are vmap'd
+over the batch axis by the step kernel's callers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def term_at(log_term: jax.Array, index1: jax.Array) -> jax.Array:
+    """Term of the 1-based `index1`-th entry per node; 0 where index1 == 0 (no entry).
+
+    log_term: [N, CAP]; index1: [N] or [N, K] -> result matches index1's shape.
+    """
+    cap = log_term.shape[-1]
+    idx = jnp.clip(index1 - 1, 0, cap - 1)
+    if index1.ndim == 1:
+        got = jnp.take_along_axis(log_term, idx[:, None], axis=1)[:, 0]
+    else:
+        got = jnp.take_along_axis(log_term, idx, axis=1)
+    return jnp.where(index1 > 0, got, 0)
+
+
+def last_index_term(log_term: jax.Array, log_len: jax.Array):
+    """(last 1-based index, term of last entry) per node -- spec-correct `last-entry`."""
+    return log_len, term_at(log_term, log_len)
+
+
+def window(arr: jax.Array, start0: jax.Array, e: int) -> jax.Array:
+    """Gather an E-entry window per (row, start): out[..., k] = arr[row, start0 + k].
+
+    arr: [N, CAP]; start0: [N] or [N, M] 0-based start slot. Out-of-range slots return
+    arr's last slot (callers mask with an explicit count).
+    """
+    cap = arr.shape[-1]
+    ks = jnp.arange(e, dtype=jnp.int32)
+    pos = jnp.clip(start0[..., None] + ks, 0, cap - 1)  # [N, (M,) E]
+    n = arr.shape[0]
+    if start0.ndim == 1:
+        rows = jnp.arange(n)[:, None]
+    else:
+        rows = jnp.arange(n)[:, None, None]
+    return arr[rows, pos]
+
+
+def write_window(
+    arr: jax.Array,
+    start0: jax.Array,
+    vals: jax.Array,
+    mask: jax.Array,
+) -> jax.Array:
+    """Scatter vals[n, k] into arr[n, start0[n] + k] where mask[n, k]; masked-off or
+    out-of-capacity writes are dropped.
+
+    arr: [N, CAP]; start0: [N]; vals/mask: [N, E].
+    """
+    n, cap = arr.shape
+    e = vals.shape[-1]
+    ks = jnp.arange(e, dtype=jnp.int32)
+    pos = start0[:, None] + ks  # [N, E]
+    # Route masked-off writes out of bounds; mode='drop' discards them.
+    pos = jnp.where(mask, pos, cap)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
+    return arr.at[rows, pos].set(vals, mode="drop")

@@ -1,0 +1,93 @@
+"""Fault injection and per-tick input generation, as pure data.
+
+In the reference, faults are accidental: a dead or unreachable peer makes the outbound
+HTTP call throw, the exception is swallowed, and the message vanishes (client.clj:38-40);
+election timeouts are the only failure detector (core.clj:171-174); there is no fault
+*injection* at all (SURVEY.md section 5). Here fault schedules are first-class pure
+inputs derived deterministically from (cluster key, tick):
+
+  - Bernoulli message drop, optionally with a per-cluster drop rate drawn from
+    [0, drop_prob] (BASELINE config 4),
+  - rolling partitions: every `partition_period` ticks the cluster is (with some
+    probability) split into two random halves whose cross edges deliver nothing
+    (BASELINE config 5),
+  - clock skew: a node's local clock occasionally stalls (+0) or jumps (+2),
+  - randomized election-timeout draws (the reference's 5000+rand(5000) ms,
+    core.clj:174),
+  - client command injection on a fixed cadence (the reference's external curl against
+    /client-set, server.clj:8-12).
+
+Everything is a function of (key, now), so trajectories are replayable from a seed and
+checkpoint/resume needs only (state, key) -- no RNG state in the carry.
+
+The per-cluster key is split once into disjoint streams (per-tick draws, per-cluster
+drop rate, per-window partition layout) so no fold_in value can collide across
+purposes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu.types import NIL, StepInputs
+from raft_sim_tpu.utils.config import RaftConfig
+from raft_sim_tpu.utils.rng import draw_timeouts
+
+
+def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
+    """Inputs for one cluster at tick `now`. `key` is the per-cluster base key."""
+    n = cfg.n_nodes
+    k_ticks, k_rate, k_part = jax.random.split(key, 3)
+    tkey = jax.random.fold_in(k_ticks, now)
+    k_drop, k_timeout, k_skew = jax.random.split(tkey, 3)
+
+    # Message drop (the reference's silently-dropped RPC, client.clj:38-40).
+    if cfg.drop_prob > 0:
+        if cfg.drop_prob_uniform:
+            p = jax.random.uniform(k_rate, (), maxval=cfg.drop_prob)
+        else:
+            p = cfg.drop_prob
+        deliver = ~jax.random.bernoulli(k_drop, p, (n, n))
+    else:
+        deliver = jnp.ones((n, n), bool)
+
+    # Rolling partitions: assignment is stable within each window of
+    # `partition_period` ticks because it is keyed by the window index, not the tick.
+    if cfg.partition_period > 0:
+        window = now // cfg.partition_period
+        wkey = jax.random.fold_in(k_part, window)
+        k_group, k_active = jax.random.split(wkey)
+        group = jax.random.bernoulli(k_group, 0.5, (n,))
+        active = jax.random.bernoulli(k_active, cfg.partition_prob)
+        same_side = group[:, None] == group[None, :]
+        deliver = deliver & (same_side | ~active)
+
+    # Clock skew.
+    if cfg.clock_skew_prob > 0:
+        u = jax.random.uniform(k_skew, (n,))
+        skew = jnp.where(
+            u < cfg.clock_skew_prob / 2,
+            0,
+            jnp.where(u < cfg.clock_skew_prob, 2, 1),
+        ).astype(jnp.int32)
+    else:
+        skew = jnp.ones((n,), jnp.int32)
+
+    # Election-timeout draws (one per node per tick, used on any timer reset).
+    timeout_draw = draw_timeouts(cfg, k_timeout, n)
+
+    # Client commands: value = tick at injection (payload bytes carry no protocol
+    # meaning in the reference either, log.clj:66-67).
+    if cfg.client_interval > 0:
+        client_cmd = jnp.where(now % cfg.client_interval == 0, now + 1, NIL)
+    else:
+        client_cmd = jnp.int32(NIL)
+    client_cmd = jnp.asarray(client_cmd, jnp.int32)
+
+    return StepInputs(
+        deliver_mask=deliver,
+        skew=skew,
+        timeout_draw=timeout_draw,
+        client_cmd=client_cmd,
+    )

@@ -1,0 +1,176 @@
+"""Struct-of-arrays state for batched Raft cluster simulation.
+
+The reference keeps per-node state in a Clojure map (init-node, core.clj:31-38) plus a
+log atom {:entries [{:term,:val}] :commit-index} (log.clj:33-34), and exchanges messages
+as JSON over HTTP with core.async channels as mailboxes (server.clj:37, client.clj:18).
+
+Here one *cluster* is a pytree of dense arrays over the node axis N; `vmap` lifts every
+shape to [batch, N, ...]. Messages live in a dense [N, N] mailbox -- one in-flight slot
+per directed edge, indexed [dst, src] -- replacing the reference's buffered(5) channels.
+Overwriting an undelivered slot is a legal drop (the reference drops on any HTTP
+exception, client.clj:38-40), and requests/responses occupy separate mailboxes because a
+request sent at tick t is handled at t+1 and its response lands at t+2, mirroring the
+reference's two-tick RPC structure (SURVEY.md section 3.2).
+
+All integers are int32; node ids are 0-based with -1 as nil (the reference uses 1-based
+ids and `nil`, core.clj:31-38). Log indices are 1-based counts like the reference/spec
+(entry i lives at array slot i-1; index 0 means "no entry", log.clj:20-23).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu.utils.config import RaftConfig
+from raft_sim_tpu.utils.rng import draw_timeouts
+
+# Node roles (reference keywords :follower/:candidate/:leader, core.clj:31-38;
+# the reference's misspelled :follwer (core.clj:76) is a documented bug, not carried).
+FOLLOWER = 0
+CANDIDATE = 1
+LEADER = 2
+
+# Request mailbox record types (reference URI routing, server.clj:8-12).
+REQ_NONE = 0
+REQ_VOTE = 1  # :request-vote
+REQ_APPEND = 2  # :append-entries
+
+# Response mailbox record types (client.clj:8-9 keywordizes :type from the HTTP body).
+RESP_NONE = 0
+RESP_VOTE = 1  # :vote-response
+RESP_APPEND = 2  # :append-response
+
+NIL = -1  # nil node id
+
+
+class Mailbox(NamedTuple):
+    """One in-flight RPC slot per directed edge, indexed [dst, src].
+
+    Request fields overlay both message types (reference wire formats core.clj:51-54 and
+    core.clj:62-67):
+      REQ_VOTE:   prev_index = last-log-index, prev_term = last-log-term
+      REQ_APPEND: prev_index/prev_term/commit/n_ent/ent_term/ent_val as named
+    Response fields overlay :vote-response {term,vote-granted} (core.clj:95-102) and
+    :append-response {term,success,log-index} (core.clj:109-121): `ok` is
+    granted/success, `match` is the acknowledged log index for successful appends.
+    """
+
+    req_type: jax.Array  # [N, N] int32 (REQ_*)
+    req_term: jax.Array  # [N, N] int32
+    req_prev_index: jax.Array  # [N, N] int32
+    req_prev_term: jax.Array  # [N, N] int32
+    req_commit: jax.Array  # [N, N] int32
+    req_n_ent: jax.Array  # [N, N] int32
+    req_ent_term: jax.Array  # [N, N, E] int32
+    req_ent_val: jax.Array  # [N, N, E] int32
+    resp_type: jax.Array  # [N, N] int32 (RESP_*)
+    resp_term: jax.Array  # [N, N] int32
+    resp_ok: jax.Array  # [N, N] bool
+    resp_match: jax.Array  # [N, N] int32
+
+
+class ClusterState(NamedTuple):
+    """Full per-cluster simulator state (the scan carry).
+
+    Maps the reference node map + log atom (SURVEY.md section 2.2) onto arrays:
+      role/term/voted_for/leader_id  <- :state/:current-term/:voted-for/:leader-id
+      votes [N,N] bool bitmap        <- :votes set (core.clj:38)
+      next_index/match_index [N,N]   <- :leader-state maps (core.clj:40-42)
+      log_term/log_val/log_len       <- log atom :entries (log.clj:33)
+      commit_index                   <- log atom :commit-index
+      clock/deadline                 <- async/timeout channels (core.clj:171-174)
+    """
+
+    role: jax.Array  # [N] int32
+    term: jax.Array  # [N] int32 (starts at 1, core.clj:34)
+    voted_for: jax.Array  # [N] int32 (NIL = none)
+    leader_id: jax.Array  # [N] int32 (NIL = unknown)
+    votes: jax.Array  # [N, N] bool; votes[i, j] = i holds a granted vote from j
+    next_index: jax.Array  # [N, N] int32; leader i's next index for peer j
+    match_index: jax.Array  # [N, N] int32
+    commit_index: jax.Array  # [N] int32
+    log_term: jax.Array  # [N, CAP] int32
+    log_val: jax.Array  # [N, CAP] int32
+    log_len: jax.Array  # [N] int32
+    clock: jax.Array  # [N] int32 local (skewable) clock
+    deadline: jax.Array  # [N] int32 next timer fire on the local clock
+    now: jax.Array  # scalar int32 global tick counter
+    mailbox: Mailbox
+
+
+class StepInputs(NamedTuple):
+    """Pure per-tick inputs. Randomness is *materialized outside* the step kernel so the
+    same arrays can drive both the jnp kernel and the Python oracle (tests), and so fault
+    schedules are plain data (SURVEY.md section 5, failure injection)."""
+
+    deliver_mask: jax.Array  # [N, N] bool; False = message on edge [dst, src] dropped
+    skew: jax.Array  # [N] int32 local-clock increment this tick (normally 1)
+    timeout_draw: jax.Array  # [N] int32 election timeout to use on any timer reset
+    client_cmd: jax.Array  # scalar int32 command value offered to the leader; NIL = none
+
+
+class StepInfo(NamedTuple):
+    """Small per-tick outputs: on-device safety invariants + observability reductions
+    (SURVEY.md section 5, metrics). All scalars per cluster."""
+
+    viol_election_safety: jax.Array  # bool: two leaders share a term
+    viol_commit: jax.Array  # bool: commit regressed or exceeds log length
+    viol_log_matching: jax.Array  # bool (False unless cfg.check_log_matching)
+    leader: jax.Array  # int32: lowest-id current leader, NIL if none
+    n_leaders: jax.Array  # int32: number of nodes in LEADER role
+    max_term: jax.Array  # int32
+    max_commit: jax.Array  # int32
+    min_commit: jax.Array  # int32
+    msgs_delivered: jax.Array  # int32: request+response records delivered this tick
+
+
+def empty_mailbox(cfg: RaftConfig) -> Mailbox:
+    n, e = cfg.n_nodes, cfg.max_entries_per_rpc
+    i = lambda *s: jnp.zeros(s, jnp.int32)
+    return Mailbox(
+        req_type=i(n, n),
+        req_term=i(n, n),
+        req_prev_index=i(n, n),
+        req_prev_term=i(n, n),
+        req_commit=i(n, n),
+        req_n_ent=i(n, n),
+        req_ent_term=i(n, n, e),
+        req_ent_val=i(n, n, e),
+        resp_type=i(n, n),
+        resp_term=i(n, n),
+        resp_ok=jnp.zeros((n, n), bool),
+        resp_match=i(n, n),
+    )
+
+
+def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
+    """Fresh cluster: all followers at term 1 with empty logs (init-node core.clj:31-38,
+    Log.start log.clj:32-34) and randomized initial election deadlines (the reference
+    randomizes per wait-loop iteration, core.clj:174)."""
+    n, cap = cfg.n_nodes, cfg.log_capacity
+    deadline = draw_timeouts(cfg, key, n)
+    return ClusterState(
+        role=jnp.full((n,), FOLLOWER, jnp.int32),
+        term=jnp.ones((n,), jnp.int32),
+        voted_for=jnp.full((n,), NIL, jnp.int32),
+        leader_id=jnp.full((n,), NIL, jnp.int32),
+        votes=jnp.zeros((n, n), bool),
+        next_index=jnp.ones((n, n), jnp.int32),
+        match_index=jnp.zeros((n, n), jnp.int32),
+        commit_index=jnp.zeros((n,), jnp.int32),
+        log_term=jnp.zeros((n, cap), jnp.int32),
+        log_val=jnp.zeros((n, cap), jnp.int32),
+        log_len=jnp.zeros((n,), jnp.int32),
+        clock=jnp.zeros((n,), jnp.int32),
+        deadline=deadline,
+        now=jnp.int32(0),
+        mailbox=empty_mailbox(cfg),
+    )
+
+
+def init_batch(cfg: RaftConfig, key: jax.Array, batch: int) -> ClusterState:
+    """[batch, ...] struct-of-arrays over independent clusters, each with its own seed."""
+    return jax.vmap(lambda k: init_state(cfg, k))(jax.random.split(key, batch))

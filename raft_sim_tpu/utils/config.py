@@ -1,0 +1,117 @@
+"""Simulator configuration.
+
+The reference hardcodes every constant: host 127.0.0.1 (core.clj:11), port 8080+id
+(core.clj:13), log filename (core.clj:17), channel buffer sizes 5 (server.clj:37,
+client.clj:18), heartbeat 3000 ms and election timeout 5000+rand(5000) ms
+(core.clj:171-174), and takes topology from CLI args (core.clj:197-200).
+
+Here every knob lives in one frozen (hashable) dataclass so a config can be a static
+`jit` argument: cluster size, log capacity, timer windows in *tick units* (the reference's
+3000 ms heartbeat : 5000-10000 ms election ratio is preserved as 3 : 6-12 ticks), and the
+fault-injection schedule parameters. The five BASELINE.json configs are named presets in
+`PRESETS`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftConfig:
+    """Static simulation parameters (hashable -> usable as a static jit arg)."""
+
+    # Topology (reference: CLI args, core.clj:197-200; dev default 3 nodes, dev/user.clj:14)
+    n_nodes: int = 5
+
+    # Replicated log (reference: unbounded vector, log.clj:33; XLA needs static shapes)
+    log_capacity: int = 32
+    # Max entries shipped per AppendEntries RPC (reference ships arbitrary suffixes,
+    # core.clj:59-67; a bounded window keeps the mailbox record fixed-width)
+    max_entries_per_rpc: int = 4
+
+    # Timers, in ticks (reference: 3000 ms heartbeat, 5000+rand(5000) ms election,
+    # core.clj:171-174 -- same 3 : 6..12 ratio here)
+    heartbeat_ticks: int = 3
+    election_min_ticks: int = 6
+    election_range_ticks: int = 6
+
+    # Fault injection (reference's only "fault" is a silently dropped HTTP call,
+    # client.clj:38-40; here faults are first-class pure inputs)
+    drop_prob: float = 0.0
+    # If True, each cluster draws its own drop probability uniformly from [0, drop_prob]
+    # (BASELINE config 4: p in [0, 0.3]).
+    drop_prob_uniform: bool = False
+    # Rolling partitions: every `partition_period` ticks, with prob `partition_prob`,
+    # split the cluster into two random halves that cannot exchange messages.
+    partition_period: int = 0
+    partition_prob: float = 0.0
+    # Clock skew: each tick, a node's local clock advances by 0 or 2 instead of 1 with
+    # this probability (split evenly between stall and jump).
+    clock_skew_prob: float = 0.0
+
+    # Client command injection (reference: external curl POST /client-set,
+    # server.clj:8-12, core.clj:151-160). Every `client_interval` ticks one command is
+    # offered to each cluster's current leader; 0 disables.
+    client_interval: int = 0
+
+    # On-device safety checking (north star: invariants checked every tick)
+    check_invariants: bool = True
+    # Log-matching check is O(N^2 * CAP) per tick -- gate separately.
+    check_log_matching: bool = False
+
+    def __post_init__(self):
+        assert self.n_nodes >= 2
+        assert 1 <= self.max_entries_per_rpc <= self.log_capacity
+        assert self.heartbeat_ticks >= 1
+        assert self.election_min_ticks > self.heartbeat_ticks
+        assert self.election_range_ticks >= 1
+
+    @property
+    def quorum(self) -> int:
+        """Votes needed for leadership: floor(N/2)+1.
+
+        The reference computes ceil(N/2) over peers+self (majority? core.clj:19-21),
+        which equals floor(N/2)+1 for odd N but is NOT a majority for even N
+        (ceil(4/2)=2 of 4). We use the spec-correct strict majority.
+        """
+        return self.n_nodes // 2 + 1
+
+
+# The five BASELINE.json configs as named presets (see BASELINE.md). config1 is the
+# 10k-tick correctness reference: its log capacity must hold every command injected
+# over the run (10k ticks / interval 8 = 1250 commands).
+PRESETS: dict[str, tuple[RaftConfig, int]] = {
+    # name -> (config, batch size)
+    "config1": (
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=2048,
+            max_entries_per_rpc=8,
+            client_interval=8,
+            check_log_matching=True,
+        ),
+        1,
+    ),
+    "config2": (RaftConfig(n_nodes=5, client_interval=8), 1_000),
+    "config3": (RaftConfig(n_nodes=5), 100_000),
+    "config4": (
+        RaftConfig(
+            n_nodes=7,
+            drop_prob=0.3,
+            drop_prob_uniform=True,
+            clock_skew_prob=0.1,
+        ),
+        100_000,
+    ),
+    "config5": (
+        RaftConfig(
+            n_nodes=51,
+            log_capacity=16,
+            partition_period=32,
+            partition_prob=0.5,
+            check_invariants=True,
+        ),
+        10_000,
+    ),
+}

@@ -1,0 +1,21 @@
+"""Shared randomness helpers (single source of truth for timer distributions)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu.utils.config import RaftConfig
+
+
+def draw_timeouts(cfg: RaftConfig, key: jax.Array, n: int) -> jax.Array:
+    """Randomized election timeouts in ticks, one per node (the reference's
+    5000 + rand(5000) ms, core.clj:174). Used both for initial deadlines and for every
+    timer reset so both come from the same distribution."""
+    return jax.random.randint(
+        key,
+        (n,),
+        cfg.election_min_ticks,
+        cfg.election_min_ticks + cfg.election_range_ticks,
+        jnp.int32,
+    )
