@@ -1,0 +1,62 @@
+"""Headline benchmark: cluster-ticks/sec/chip on the BASELINE north-star workload.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}. The baseline is the
+north-star target from BASELINE.json (>=1M cluster-ticks/sec/chip at 100k x 5-node
+clusters with randomized election timeouts -- config 3); `vs_baseline` is
+value / 1_000_000. The reference publishes no numbers of its own (SURVEY.md section 6).
+
+Usage: python bench.py [--preset config3] [--batch N] [--ticks N] [--repeats N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+from raft_sim_tpu import PRESETS, RaftConfig
+from raft_sim_tpu.sim import scan
+
+NORTH_STAR = 1_000_000.0  # cluster-ticks/sec/chip, BASELINE.json north_star
+
+
+def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 3) -> dict:
+    # Warmup compiles init + scan; timed runs hit the executable cache.
+    final, metrics = scan.simulate(cfg, 0, batch, ticks)
+    jax.block_until_ready((final, metrics))
+
+    best = float("inf")
+    for r in range(1, repeats + 1):
+        t0 = time.perf_counter()
+        final, metrics = scan.simulate(cfg, r, batch, ticks)
+        jax.block_until_ready((final, metrics))
+        best = min(best, time.perf_counter() - t0)
+
+    value = batch * ticks / best
+    return {
+        "metric": "cluster-ticks/sec/chip",
+        "value": round(value, 1),
+        "unit": "cluster-ticks/s",
+        "vs_baseline": round(value / NORTH_STAR, 3),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="config3", choices=sorted(PRESETS))
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--ticks", type=int, default=1000)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    cfg, preset_batch = PRESETS[args.preset]
+    batch = args.batch if args.batch is not None else preset_batch
+    result = bench(cfg, batch, args.ticks, args.repeats)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

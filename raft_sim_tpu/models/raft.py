@@ -277,8 +277,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     out_req_prev_term = jnp.where(rv_edge, new_last_term[:, None], out_prev_term_ae)
     out_req_commit = jnp.broadcast_to(commit[:, None], (n, n))
     out_req_n_ent = jnp.where(ae_edge, n_out, 0)
-    out_ent_term = log_ops.window(log_term_arr, prev_out, e)  # [src, dst, E]
-    out_ent_val = log_ops.window(log_val_arr, prev_out, e)
+    # Zero entry slots beyond n_out so the mailbox is canonical (receivers mask with
+    # n_ent anyway, but a canonical wire format keeps trajectories bit-comparable).
+    ent_used = ks[None, None, :] < n_out[:, :, None]  # [src, dst, E]
+    out_ent_term = jnp.where(ent_used, log_ops.window(log_term_arr, prev_out, e), 0)
+    out_ent_val = jnp.where(ent_used, log_ops.window(log_val_arr, prev_out, e), 0)
 
     # Responses: vr_out/ar_out are [dst_of_request, src_of_request]; the response
     # travels back src<->dst, i.e. a transpose (the reference's resp-chan round trip,

@@ -1,0 +1,9 @@
+from raft_sim_tpu.parallel.mesh import (
+    AXIS,
+    FleetSummary,
+    make_mesh,
+    simulate_sharded,
+    summarize,
+)
+
+__all__ = ["AXIS", "FleetSummary", "make_mesh", "simulate_sharded", "summarize"]

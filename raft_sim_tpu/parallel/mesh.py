@@ -1,0 +1,118 @@
+"""Multi-chip execution: shard the independent-cluster batch axis over a device mesh.
+
+The reference's "distributed backend" is point-to-point HTTP between one OS process per
+Raft node (server.clj:37-39, client.clj:34-40). In the rebuild, *intra-cluster* traffic
+is the dense mailbox inside the step kernel (types.py); *across chips* the workload is
+embarrassingly parallel -- clusters are independent -- so ICI carries only the batch
+sharding installed here plus small psum metric reductions. No NCCL analogue is needed
+beyond XLA's collectives (SURVEY.md section 5, distributed communication backend).
+
+Design: per-cluster PRNG keys are split OUTSIDE the sharded region, so a run is
+bit-identical for the same (seed, batch) at any device count -- the distributed parity
+property tested in tests/test_parallel.py. `shard_map` (not bare jit-with-shardings) is
+used so the compiled program provably contains no accidental cross-device traffic in the
+hot loop; the only cross-device movement is the host-side gather in `summarize`, which
+pulls the small per-cluster RunMetrics off device for the fleet rollup.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_sim_tpu.sim import scan
+from raft_sim_tpu.types import init_state
+from raft_sim_tpu.utils.config import RaftConfig
+
+AXIS = "clusters"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the flat device list; the single named axis shards the batch of
+    independent clusters (the rebuild's only data-parallel axis, SURVEY.md section 2)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"requested {n_devices} devices, only {len(devices)} available")
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def _run_shard(cfg: RaftConfig, n_ticks: int, keys_init, keys_run):
+    """Body executed per shard: init + scan the local slice of clusters."""
+    state = jax.vmap(lambda k: init_state(cfg, k))(keys_init)
+    final, metrics, _ = scan.run_batch(cfg, state, keys_run, n_ticks)
+    return final, metrics
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4))
+def simulate_sharded(cfg: RaftConfig, seed, batch: int, n_ticks: int, mesh: Mesh):
+    """Batched simulation sharded over `mesh`. Returns (final_state, RunMetrics), both
+    with the leading batch axis sharded over the mesh.
+
+    Bit-identical to `scan.simulate` for the same (cfg, seed, batch, n_ticks): the
+    per-cluster key split happens before sharding, so device count does not perturb
+    any cluster's trajectory.
+    """
+    n_dev = mesh.devices.size
+    if batch % n_dev:
+        raise ValueError(f"batch {batch} must divide over {n_dev} devices")
+    root = jax.random.key(seed)
+    k_init, k_run = jax.random.split(root)
+    keys_init = jax.random.split(k_init, batch)
+    keys_run = jax.random.split(k_run, batch)
+
+    # check_vma=False: the scan carry mixes axis-invariant constants (init_metrics
+    # zeros) with per-cluster varying state; there is no cross-device communication in
+    # the body, so the varying-manual-axes bookkeeping is disabled.
+    sharded = jax.shard_map(
+        functools.partial(_run_shard, cfg, n_ticks),
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=P(AXIS),
+        check_vma=False,
+    )
+    keys_init = jax.lax.with_sharding_constraint(
+        keys_init, NamedSharding(mesh, P(AXIS))
+    )
+    keys_run = jax.lax.with_sharding_constraint(keys_run, NamedSharding(mesh, P(AXIS)))
+    return sharded(keys_init, keys_run)
+
+
+class FleetSummary(NamedTuple):
+    """Host-side rollup of per-cluster RunMetrics across the whole fleet. The
+    per-cluster metric arrays are tiny ([batch] int32s), so this is a plain
+    device_get + numpy reduction, not an on-device collective."""
+
+    n_clusters: int
+    total_violations: int
+    n_stable: int  # clusters that ended with a continuously-held leader
+    p50_stable_tick: float  # median ticks-to-stable-leader
+    max_term: int
+    total_msgs: int
+
+
+def summarize(metrics) -> FleetSummary:
+    """Fleet-level rollup of a batched RunMetrics. The p50 quantile is computed
+    host-side from the (small, [batch]-shaped) stable-tick vector."""
+    stable = jax.device_get(scan.stable_leader_ticks(metrics))
+    import numpy as np
+
+    reached = stable[stable < scan.NEVER]
+    p50 = float(np.median(reached)) if reached.size else float("inf")
+    m = jax.device_get(metrics)
+    return FleetSummary(
+        n_clusters=int(m.ticks.shape[0]),
+        total_violations=int(np.sum(m.violations)),
+        n_stable=int(reached.size),
+        p50_stable_tick=p50,
+        max_term=int(np.max(m.max_term)),
+        total_msgs=int(np.sum(m.total_msgs, dtype=np.int64)),
+    )

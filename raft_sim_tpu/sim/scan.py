@@ -25,7 +25,10 @@ from raft_sim_tpu.sim import faults
 from raft_sim_tpu.types import NIL, ClusterState, StepInfo
 from raft_sim_tpu.utils.config import RaftConfig
 
-_BIG = jnp.int32(2**31 - 1)
+# Sentinel for "never happened" tick values (first leader, stable leader). Public so
+# consumers (parallel.summarize, tests) compare against the same constant.
+NEVER = 2**31 - 1
+_BIG = jnp.int32(NEVER)
 
 
 class RunMetrics(NamedTuple):

@@ -1,10 +1,15 @@
 """Test env: run JAX on CPU with 8 virtual devices so the multi-chip sharding tier can
-be tested without TPU hardware (SURVEY.md section 4). Must run before any jax import in
-the test process."""
+be tested without TPU hardware (SURVEY.md section 4). The TPU plugin in this image
+registers itself via sitecustomize and overrides JAX_PLATFORMS, so the CPU platform is
+forced through jax.config after import instead; XLA_FLAGS must still carry the virtual
+device count before the CPU client is first created."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

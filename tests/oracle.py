@@ -1,0 +1,324 @@
+"""Scalar-Python oracle for the Raft tick semantics.
+
+An independent re-implementation of raft_sim_tpu.models.raft.step using plain Python
+loops and if/else over numpy state -- the `cond`-cascade form of the handlers (the shape
+the reference writes them in, core.clj:91-169) -- used to cross-check the vectorized
+`jnp.where` lattice, whose branch precedence is the hard part of the rebuild
+(SURVEY.md section 7.3). Deliberately written for clarity, not speed; every phase
+mirrors the kernel's documented phase order:
+
+  deliver -> adopt terms -> vote requests -> append requests -> responses ->
+  leader commit -> client inject -> timers -> outbox
+
+The oracle operates on dicts of numpy arrays (the device ClusterState pulled host-side)
+so the parity test can compare entire states bit-for-bit after every tick.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+REQ_NONE, REQ_VOTE, REQ_APPEND = 0, 1, 2
+RESP_NONE, RESP_VOTE, RESP_APPEND = 0, 1, 2
+NIL = -1
+
+
+def state_to_dict(state) -> dict:
+    """Host-side copy of a single-cluster ClusterState (device pytree -> numpy)."""
+    d = {
+        f: np.asarray(v)
+        for f, v in zip(state._fields, state)
+        if f != "mailbox"
+    }
+    mb = state.mailbox
+    d["mailbox"] = {f: np.asarray(v) for f, v in zip(mb._fields, mb)}
+    return d
+
+
+def term_at(log_term: np.ndarray, index1: int) -> int:
+    """Term of the 1-based entry `index1`; 0 for index1 == 0 (no entry)."""
+    if index1 <= 0:
+        return 0
+    cap = log_term.shape[0]
+    return int(log_term[min(index1 - 1, cap - 1)])
+
+
+def oracle_step(cfg, s: dict, inp: dict) -> dict:
+    """One tick for one cluster; returns a fresh state dict."""
+    n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
+    mb = s["mailbox"]
+
+    role = s["role"].copy()
+    term = s["term"].copy()
+    voted_for = s["voted_for"].copy()
+    leader_id = s["leader_id"].copy()
+    votes = s["votes"].copy()
+    next_index = s["next_index"].copy()
+    match_index = s["match_index"].copy()
+    commit = s["commit_index"].copy()
+    log_term = s["log_term"].copy()
+    log_val = s["log_val"].copy()
+    log_len = s["log_len"].copy()
+
+    # ---- phase 0: delivery
+    deliver = np.asarray(inp["deliver_mask"], bool).copy()
+    np.fill_diagonal(deliver, False)
+    req_in = deliver & (mb["req_type"] != 0)
+    resp_in = deliver & (mb["resp_type"] != 0)
+
+    # ---- phase 1: term adoption
+    saw_higher = np.zeros(n, bool)
+    for d in range(n):
+        in_term = 0
+        for src in range(n):
+            if req_in[d, src]:
+                in_term = max(in_term, int(mb["req_term"][d, src]))
+            if resp_in[d, src]:
+                in_term = max(in_term, int(mb["resp_term"][d, src]))
+        if in_term > term[d]:
+            saw_higher[d] = True
+            term[d] = in_term
+            role[d] = FOLLOWER
+            voted_for[d] = NIL
+            leader_id[d] = NIL
+            votes[d, :] = False
+
+    # ---- phase 2: RequestVote requests
+    granted_any = np.zeros(n, bool)
+    vr_out = np.zeros((n, n), bool)  # [dst, src]: respond to src
+    vr_granted = np.zeros((n, n), bool)
+    for d in range(n):
+        my_last_idx = int(s["log_len"][d])
+        my_last_term = term_at(s["log_term"][d], my_last_idx)
+        can = []
+        for src in range(n):
+            if not (req_in[d, src] and mb["req_type"][d, src] == REQ_VOTE):
+                continue
+            vr_out[d, src] = True
+            if mb["req_term"][d, src] != term[d]:
+                continue
+            c_idx = int(mb["req_prev_index"][d, src])
+            c_term = int(mb["req_prev_term"][d, src])
+            up_to_date = c_term > my_last_term or (
+                c_term == my_last_term and c_idx >= my_last_idx
+            )
+            if up_to_date:
+                can.append(src)
+        if not can:
+            continue
+        if voted_for[d] != NIL:
+            if voted_for[d] in can:  # idempotent re-grant
+                vr_granted[d, voted_for[d]] = True
+                granted_any[d] = True
+        else:
+            winner = min(can)
+            vr_granted[d, winner] = True
+            granted_any[d] = True
+            voted_for[d] = winner
+
+    # ---- phase 3: AppendEntries requests
+    has_ae = np.zeros(n, bool)
+    ar_out = np.zeros((n, n), bool)
+    ar_success = np.zeros((n, n), bool)
+    ar_match = np.zeros((n, n), np.int32)
+    for d in range(n):
+        cur = [
+            src
+            for src in range(n)
+            if req_in[d, src]
+            and mb["req_type"][d, src] == REQ_APPEND
+        ]
+        for src in cur:
+            ar_out[d, src] = True
+        cur_term = [src for src in cur if mb["req_term"][d, src] == term[d]]
+        if not cur_term:
+            continue
+        src = min(cur_term)
+        has_ae[d] = True
+        if role[d] == CANDIDATE:
+            role[d] = FOLLOWER
+        leader_id[d] = src
+
+        prev_i = int(mb["req_prev_index"][d, src])
+        prev_t = int(mb["req_prev_term"][d, src])
+        lcommit = int(mb["req_commit"][d, src])
+        n_ent = int(mb["req_n_ent"][d, src])
+        ent_t = mb["req_ent_term"][d, src]
+        ent_v = mb["req_ent_val"][d, src]
+
+        consistent = prev_i == 0 or (
+            prev_i <= int(s["log_len"][d])
+            and term_at(s["log_term"][d], prev_i) == prev_t
+        )
+        if not consistent:
+            continue
+
+        any_mismatch = any(
+            k < n_ent
+            and prev_i + k < int(s["log_len"][d])
+            and int(s["log_term"][d][prev_i + k]) != int(ent_t[k])
+            for k in range(e)
+        )
+        appended_len = min(prev_i + n_ent, cap)
+        new_len = appended_len if any_mismatch else max(int(s["log_len"][d]), appended_len)
+        for k in range(n_ent):
+            pos = prev_i + k
+            if pos < cap:
+                log_term[d, pos] = ent_t[k]
+                log_val[d, pos] = ent_v[k]
+        log_len[d] = new_len
+
+        last_new = min(prev_i + n_ent, new_len)
+        commit[d] = max(int(commit[d]), min(lcommit, last_new))
+        ar_success[d, src] = True
+        ar_match[d, src] = last_new
+
+    # ---- phase 4: responses
+    for d in range(n):
+        for src in range(n):
+            if (
+                resp_in[d, src]
+                and mb["resp_type"][d, src] == RESP_VOTE
+                and mb["resp_ok"][d, src]
+                and mb["resp_term"][d, src] == term[d]
+                and role[d] == CANDIDATE
+            ):
+                votes[d, src] = True
+    win = np.zeros(n, bool)
+    for d in range(n):
+        if role[d] == CANDIDATE and int(votes[d].sum()) >= cfg.quorum:
+            win[d] = True
+            role[d] = LEADER
+            leader_id[d] = d
+            next_index[d, :] = log_len[d] + 1
+            match_index[d, :] = 0
+    for d in range(n):
+        if role[d] != LEADER:
+            continue
+        for src in range(n):
+            if not (
+                resp_in[d, src]
+                and mb["resp_type"][d, src] == RESP_APPEND
+                and mb["resp_term"][d, src] == term[d]
+            ):
+                continue
+            if mb["resp_ok"][d, src]:
+                m = int(mb["resp_match"][d, src])
+                match_index[d, src] = max(int(match_index[d, src]), m)
+                next_index[d, src] = max(int(next_index[d, src]), m + 1)
+            else:
+                next_index[d, src] = max(int(next_index[d, src]) - 1, 1)
+
+    # ---- phase 5: leader commit advancement
+    for d in range(n):
+        if role[d] != LEADER:
+            continue
+        match = match_index[d].copy()
+        match[d] = log_len[d]
+        quorum_match = int(np.sort(match)[::-1][cfg.quorum - 1])
+        if quorum_match > commit[d] and term_at(log_term[d], quorum_match) == term[d]:
+            commit[d] = quorum_match
+
+    # ---- phase 6: client injection
+    cmd = int(inp["client_cmd"])
+    for d in range(n):
+        if cmd != NIL and role[d] == LEADER and log_len[d] < cap:
+            log_term[d, log_len[d]] = term[d]
+            log_val[d, log_len[d]] = cmd
+            log_len[d] += 1
+
+    # ---- phase 7: timers
+    clock = s["clock"] + np.asarray(inp["skew"], np.int32)
+    deadline = s["deadline"].copy()
+    heartbeat = np.zeros(n, bool)
+    start_election = np.zeros(n, bool)
+    for d in range(n):
+        if granted_any[d] or has_ae[d] or saw_higher[d]:
+            deadline[d] = clock[d] + int(inp["timeout_draw"][d])
+        if win[d]:
+            deadline[d] = clock[d] + cfg.heartbeat_ticks
+        expired = clock[d] >= deadline[d]
+        if expired and role[d] == LEADER:
+            heartbeat[d] = True
+            deadline[d] = clock[d] + cfg.heartbeat_ticks
+        elif expired:
+            start_election[d] = True
+            term[d] += 1
+            role[d] = CANDIDATE
+            voted_for[d] = d
+            leader_id[d] = NIL
+            votes[d, :] = False
+            votes[d, d] = True
+            deadline[d] = clock[d] + int(inp["timeout_draw"][d])
+
+    # ---- phase 8: outbox
+    z = lambda *shape: np.zeros(shape, np.int32)
+    out = {
+        "req_type": z(n, n),
+        "req_term": z(n, n),
+        "req_prev_index": z(n, n),
+        "req_prev_term": z(n, n),
+        "req_commit": z(n, n),
+        "req_n_ent": z(n, n),
+        "req_ent_term": z(n, n, e),
+        "req_ent_val": z(n, n, e),
+        "resp_type": z(n, n),
+        "resp_term": z(n, n),
+        "resp_ok": np.zeros((n, n), bool),
+        "resp_match": z(n, n),
+    }
+    for src in range(n):
+        last_idx = int(log_len[src])
+        last_term = term_at(log_term[src], last_idx)
+        for dst in range(n):
+            if dst == src:
+                continue
+            if start_election[src]:
+                out["req_type"][dst, src] = REQ_VOTE
+                out["req_term"][dst, src] = term[src]
+                out["req_prev_index"][dst, src] = last_idx
+                out["req_prev_term"][dst, src] = last_term
+            elif win[src] or heartbeat[src]:
+                prev = min(max(int(next_index[src, dst]) - 1, 0), int(log_len[src]))
+                cnt = min(max(int(log_len[src]) - prev, 0), e)
+                out["req_type"][dst, src] = REQ_APPEND
+                out["req_term"][dst, src] = term[src]
+                out["req_prev_index"][dst, src] = prev
+                out["req_prev_term"][dst, src] = term_at(log_term[src], prev)
+                out["req_commit"][dst, src] = commit[src]
+                out["req_n_ent"][dst, src] = cnt
+                for k in range(cnt):
+                    out["req_ent_term"][dst, src, k] = log_term[src, prev + k]
+                    out["req_ent_val"][dst, src, k] = log_val[src, prev + k]
+    # Responses travel back src<->dst: responder r answers requester q.
+    for r in range(n):
+        for q in range(n):
+            rtype = 0
+            if vr_out[r, q]:
+                rtype += RESP_VOTE
+            if ar_out[r, q]:
+                rtype += RESP_APPEND
+            if rtype:
+                out["resp_type"][q, r] = rtype
+                out["resp_term"][q, r] = term[r]
+                out["resp_ok"][q, r] = bool(vr_granted[r, q] or ar_success[r, q])
+                out["resp_match"][q, r] = ar_match[r, q]
+
+    return {
+        "role": role,
+        "term": term,
+        "voted_for": voted_for,
+        "leader_id": leader_id,
+        "votes": votes,
+        "next_index": next_index,
+        "match_index": match_index,
+        "commit_index": commit,
+        "log_term": log_term,
+        "log_val": log_val,
+        "log_len": log_len,
+        "clock": clock,
+        "deadline": deadline,
+        "now": np.int32(int(s["now"]) + 1),
+        "mailbox": out,
+    }

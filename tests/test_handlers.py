@@ -1,0 +1,439 @@
+"""Per-handler unit tests against hand-built states (SURVEY.md section 4, unit tier).
+
+Each test constructs a precise cluster state + mailbox, runs one tick, and asserts the
+spec-mandated outcome -- especially at the points where the reference deviates from the
+Raft paper (SURVEY.md section 2.3): term adoption on RequestVote (2.3.2), the real
+up-to-date check (2.3.3/2.3.4), leader-commit advancement from majority match (2.3.8),
+nextIndex = match+1 (2.3.10), and commit = min(leaderCommit, last new entry) (2.3.6).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_sim_tpu import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    NIL,
+    RaftConfig,
+    StepInputs,
+    init_state,
+)
+from raft_sim_tpu.models import raft
+from raft_sim_tpu.types import REQ_APPEND, REQ_VOTE, RESP_APPEND, RESP_VOTE
+
+CFG = RaftConfig(n_nodes=5, log_capacity=8, max_entries_per_rpc=4)
+
+
+def quiet_inputs(cfg, far=1000):
+    """No faults, no client traffic, clocks advancing but timers far away."""
+    n = cfg.n_nodes
+    return StepInputs(
+        deliver_mask=jnp.ones((n, n), bool),
+        skew=jnp.ones((n,), jnp.int32),
+        timeout_draw=jnp.full((n,), far, jnp.int32),
+        client_cmd=jnp.int32(NIL),
+    )
+
+
+def base_state(cfg=CFG, far=1000):
+    """All-follower state with timers pushed far out so nothing fires by itself."""
+    s = init_state(cfg, jax.random.key(0))
+    return s._replace(deadline=jnp.full((cfg.n_nodes,), far, jnp.int32))
+
+
+def with_log(s, node, terms):
+    """Install a log (list of entry terms; values = 100+slot) on one node."""
+    lt = s.log_term.at[node, : len(terms)].set(jnp.asarray(terms, jnp.int32))
+    lv = s.log_val.at[node, : len(terms)].set(
+        100 + jnp.arange(len(terms), dtype=jnp.int32)
+    )
+    return s._replace(
+        log_term=lt, log_val=lv, log_len=s.log_len.at[node].set(len(terms))
+    )
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_step(cfg):
+    return jax.jit(lambda s_, i_: raft.step(cfg, s_, i_))
+
+
+def step(cfg, s, inp=None):
+    return _jitted_step(cfg)(s, inp if inp is not None else quiet_inputs(cfg))
+
+
+# ---------------------------------------------------------------- RequestVote handling
+
+
+def test_vote_granted_and_term_adopted():
+    """A higher-term RequestVote makes the receiver adopt the term (reference bug
+    2.3.2: it never did) and grant when the candidate's log is up to date."""
+    s = base_state()
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[1, 0].set(5),
+        req_prev_index=s.mailbox.req_prev_index.at[1, 0].set(0),
+        req_prev_term=s.mailbox.req_prev_term.at[1, 0].set(0),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.term[1]) == 5
+    assert int(s2.voted_for[1]) == 0
+    assert int(s2.mailbox.resp_type[0, 1]) == RESP_VOTE
+    assert bool(s2.mailbox.resp_ok[0, 1])
+    assert int(s2.mailbox.resp_term[0, 1]) == 5
+
+
+def test_vote_denied_stale_term():
+    s = base_state()
+    s = s._replace(term=s.term.at[1].set(9))
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[1, 0].set(5),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.voted_for[1]) == NIL
+    # Response still sent, carrying the newer term so the candidate steps down.
+    assert int(s2.mailbox.resp_type[0, 1]) == RESP_VOTE
+    assert not bool(s2.mailbox.resp_ok[0, 1])
+    assert int(s2.mailbox.resp_term[0, 1]) == 9
+
+
+def test_vote_denied_stale_log():
+    """Up-to-date check (spec 5.4.1): voter's last entry term 3 > candidate's 2."""
+    s = with_log(base_state(), 1, [1, 3])
+    s = s._replace(term=s.term.at[1].set(4))
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[1, 0].set(4),
+        req_prev_index=s.mailbox.req_prev_index.at[1, 0].set(5),
+        req_prev_term=s.mailbox.req_prev_term.at[1, 0].set(2),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert not bool(s2.mailbox.resp_ok[0, 1])
+    assert int(s2.voted_for[1]) == NIL
+
+
+def test_vote_denied_shorter_log_same_term():
+    """Same last term, candidate's index shorter -> deny."""
+    s = with_log(base_state(), 1, [2, 2, 2])
+    s = s._replace(term=s.term.at[1].set(3))
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[1, 0].set(3),
+        req_prev_index=s.mailbox.req_prev_index.at[1, 0].set(2),
+        req_prev_term=s.mailbox.req_prev_term.at[1, 0].set(2),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert not bool(s2.mailbox.resp_ok[0, 1])
+
+
+def test_single_vote_per_term_lowest_wins():
+    """Two simultaneous candidates: one grant only, to the lowest id; the vote is
+    remembered in voted_for."""
+    s = base_state()
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[0, 2].set(REQ_VOTE).at[0, 3].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[0, 2].set(2).at[0, 3].set(2),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.voted_for[0]) == 2
+    assert bool(s2.mailbox.resp_ok[2, 0])
+    assert not bool(s2.mailbox.resp_ok[3, 0])
+
+
+def test_revote_same_candidate_is_idempotent():
+    """A retransmitted RequestVote from the already-voted-for candidate re-grants."""
+    s = base_state()
+    s = s._replace(term=s.term.at[0].set(2), voted_for=s.voted_for.at[0].set(2))
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[0, 2].set(REQ_VOTE).at[0, 3].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[0, 2].set(2).at[0, 3].set(2),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert bool(s2.mailbox.resp_ok[2, 0])
+    assert not bool(s2.mailbox.resp_ok[3, 0])
+    assert int(s2.voted_for[0]) == 2
+
+
+# ------------------------------------------------------------- AppendEntries handling
+
+
+def ae_mailbox(s, dst, src, term, prev_i, prev_t, commit, ents):
+    mb = s.mailbox
+    mb = mb._replace(
+        req_type=mb.req_type.at[dst, src].set(REQ_APPEND),
+        req_term=mb.req_term.at[dst, src].set(term),
+        req_prev_index=mb.req_prev_index.at[dst, src].set(prev_i),
+        req_prev_term=mb.req_prev_term.at[dst, src].set(prev_t),
+        req_commit=mb.req_commit.at[dst, src].set(commit),
+        req_n_ent=mb.req_n_ent.at[dst, src].set(len(ents)),
+    )
+    for k, (t, v) in enumerate(ents):
+        mb = mb._replace(
+            req_ent_term=mb.req_ent_term.at[dst, src, k].set(t),
+            req_ent_val=mb.req_ent_val.at[dst, src, k].set(v),
+        )
+    return s._replace(mailbox=mb)
+
+
+def test_append_accept_and_commit_min():
+    """Entries appended; follower commit = min(leaderCommit, last new entry) -- the
+    reference committed everything unconditionally (bug 2.3.6)."""
+    s = base_state()
+    s = s._replace(term=s.term.at[1].set(2))
+    s = ae_mailbox(s, 1, 0, term=2, prev_i=0, prev_t=0, commit=5, ents=[(2, 7), (2, 8)])
+    s2, _ = step(CFG, s)
+    assert int(s2.log_len[1]) == 2
+    assert int(s2.commit_index[1]) == 2  # min(5, 2), not 5
+    assert int(s2.leader_id[1]) == 0
+    assert bool(s2.mailbox.resp_ok[0, 1])
+    assert int(s2.mailbox.resp_match[0, 1]) == 2
+    np.testing.assert_array_equal(np.asarray(s2.log_val[1, :2]), [7, 8])
+
+
+def test_append_reject_inconsistent():
+    """prev entry missing -> reject, nothing appended (spec 5.3)."""
+    s = base_state()
+    s = s._replace(term=s.term.at[1].set(2))
+    s = ae_mailbox(s, 1, 0, term=2, prev_i=3, prev_t=1, commit=0, ents=[(2, 7)])
+    s2, _ = step(CFG, s)
+    assert int(s2.log_len[1]) == 0
+    assert int(s2.mailbox.resp_type[0, 1]) == RESP_APPEND
+    assert not bool(s2.mailbox.resp_ok[0, 1])
+
+
+def test_append_conflict_truncates():
+    """Follower has [1,1,3]; leader sends prev=1/term1 + entries [(2),(2)] ->
+    conflicting suffix replaced, log = [1,2,2] (spec: delete existing entry and all
+    that follow; the reference's remove-from! truncated the wrong end, bug 2.3.7)."""
+    s = with_log(base_state(), 1, [1, 1, 3])
+    s = s._replace(term=s.term.at[1].set(4))
+    s = ae_mailbox(s, 1, 0, term=4, prev_i=1, prev_t=1, commit=0, ents=[(2, 7), (2, 8)])
+    s2, _ = step(CFG, s)
+    assert int(s2.log_len[1]) == 3
+    np.testing.assert_array_equal(np.asarray(s2.log_term[1, :3]), [1, 2, 2])
+    np.testing.assert_array_equal(np.asarray(s2.log_val[1, 1:3]), [7, 8])
+
+
+def test_append_prefix_match_no_truncate():
+    """A stale AE covering an existing matching prefix must NOT shrink the log."""
+    s = with_log(base_state(), 1, [1, 1, 1, 1])
+    s = s._replace(term=s.term.at[1].set(2))
+    s = ae_mailbox(s, 1, 0, term=2, prev_i=0, prev_t=0, commit=0, ents=[(1, 100)])
+    s2, _ = step(CFG, s)
+    assert int(s2.log_len[1]) == 4  # max(4, 1): matching prefix kept
+
+
+def test_heartbeat_resets_election_timer_and_demotes_candidate():
+    s = base_state()
+    s = s._replace(
+        role=s.role.at[1].set(CANDIDATE),
+        term=s.term.at[1].set(3),
+        deadline=s.deadline.at[1].set(2),  # would expire soon
+    )
+    s = ae_mailbox(s, 1, 0, term=3, prev_i=0, prev_t=0, commit=0, ents=[])
+    inp = quiet_inputs(CFG, far=50)
+    s2, _ = step(CFG, s, inp)
+    assert int(s2.role[1]) == FOLLOWER
+    assert int(s2.leader_id[1]) == 0
+    assert int(s2.deadline[1]) == int(s2.clock[1]) + 50
+
+
+# ------------------------------------------------------------------ response handling
+
+
+def make_leader(s, node, term):
+    n = CFG.n_nodes
+    return s._replace(
+        role=s.role.at[node].set(LEADER),
+        term=s.term.at[node].set(term),
+        leader_id=jnp.full((n,), node, jnp.int32),
+        next_index=s.next_index.at[node].set(
+            jnp.full((n,), int(s.log_len[node]) + 1, jnp.int32)
+        ),
+    )
+
+
+def test_candidate_wins_with_quorum():
+    s = base_state()
+    s = s._replace(
+        role=s.role.at[0].set(CANDIDATE),
+        term=s.term.at[0].set(2),
+        voted_for=s.voted_for.at[0].set(0),
+        votes=s.votes.at[0, 0].set(True),
+    )
+    mb = s.mailbox._replace(
+        resp_type=s.mailbox.resp_type.at[0, 1].set(RESP_VOTE).at[0, 2].set(RESP_VOTE),
+        resp_term=s.mailbox.resp_term.at[0, 1].set(2).at[0, 2].set(2),
+        resp_ok=s.mailbox.resp_ok.at[0, 1].set(True).at[0, 2].set(True),
+    )
+    s2, info = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.role[0]) == LEADER
+    assert int(s2.leader_id[0]) == 0
+    # Fresh leader state: nextIndex = lastLog+1 = 1, matchIndex = 0 (core.clj:40-42).
+    assert all(int(x) == 1 for x in np.asarray(s2.next_index[0]))
+    assert all(int(x) == 0 for x in np.asarray(s2.match_index[0]))
+    # Immediate heartbeat to all peers (core.clj:137-138).
+    for p in range(1, 5):
+        assert int(s2.mailbox.req_type[p, 0]) == REQ_APPEND
+    assert int(info.n_leaders) == 1
+
+
+def test_candidate_needs_quorum():
+    """2 of 5 votes (self + one) is not a majority -> still candidate."""
+    s = base_state()
+    s = s._replace(
+        role=s.role.at[0].set(CANDIDATE),
+        term=s.term.at[0].set(2),
+        votes=s.votes.at[0, 0].set(True),
+    )
+    mb = s.mailbox._replace(
+        resp_type=s.mailbox.resp_type.at[0, 1].set(RESP_VOTE),
+        resp_term=s.mailbox.resp_term.at[0, 1].set(2),
+        resp_ok=s.mailbox.resp_ok.at[0, 1].set(True),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.role[0]) == CANDIDATE
+
+
+def test_stale_vote_response_ignored():
+    """A vote response from an older term must not count (core.clj:131-132)."""
+    s = base_state()
+    s = s._replace(
+        role=s.role.at[0].set(CANDIDATE),
+        term=s.term.at[0].set(5),
+        votes=s.votes.at[0, 0].set(True),
+    )
+    mb = s.mailbox._replace(
+        resp_type=s.mailbox.resp_type.at[0, 1].set(RESP_VOTE).at[0, 2].set(RESP_VOTE),
+        resp_term=s.mailbox.resp_term.at[0, 1].set(4).at[0, 2].set(4),
+        resp_ok=s.mailbox.resp_ok.at[0, 1].set(True).at[0, 2].set(True),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.role[0]) == CANDIDATE
+
+
+def test_append_response_success_updates_indices():
+    """nextIndex = ackedIndex + 1 (the reference set nextIndex = ackedIndex, 2.3.10)."""
+    s = with_log(base_state(), 0, [1, 1, 1])
+    s = make_leader(s, 0, 1)
+    mb = s.mailbox._replace(
+        resp_type=s.mailbox.resp_type.at[0, 1].set(RESP_APPEND),
+        resp_term=s.mailbox.resp_term.at[0, 1].set(1),
+        resp_ok=s.mailbox.resp_ok.at[0, 1].set(True),
+        resp_match=s.mailbox.resp_match.at[0, 1].set(2),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.match_index[0, 1]) == 2
+    assert int(s2.next_index[0, 1]) == 4  # max(4, 2+1): never regress below lastLog+1
+
+
+def test_append_response_failure_decrements_next_index():
+    s = with_log(base_state(), 0, [1, 1, 1])
+    s = make_leader(s, 0, 1)
+    mb = s.mailbox._replace(
+        resp_type=s.mailbox.resp_type.at[0, 1].set(RESP_APPEND),
+        resp_term=s.mailbox.resp_term.at[0, 1].set(1),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.next_index[0, 1]) == 3  # 4 - 1
+
+
+def test_leader_steps_down_on_higher_term_response():
+    """Higher term in any response -> revert to follower (core.clj:129-130, 144-145)."""
+    s = make_leader(base_state(), 0, 2)
+    mb = s.mailbox._replace(
+        resp_type=s.mailbox.resp_type.at[0, 1].set(RESP_APPEND),
+        resp_term=s.mailbox.resp_term.at[0, 1].set(7),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert int(s2.role[0]) == FOLLOWER
+    assert int(s2.term[0]) == 7
+    assert int(s2.leader_id[0]) == NIL
+
+
+# ----------------------------------------------------------- leader commit advancement
+
+
+def test_leader_commits_on_majority_match():
+    """match = [3(self),2,2,0,0] -> quorum(3)-th largest = 2 -> commit 2. Absent in the
+    reference entirely (bug 2.3.8)."""
+    s = with_log(base_state(), 0, [1, 1, 1])
+    s = make_leader(s, 0, 1)
+    s = s._replace(
+        match_index=s.match_index.at[0, 1].set(2).at[0, 2].set(2),
+    )
+    s2, _ = step(CFG, s)
+    assert int(s2.commit_index[0]) == 2
+
+
+def test_leader_does_not_commit_older_term_entries():
+    """Spec 5.4.2: only current-term entries commit by counting. Log terms [1,1] but
+    leader is at term 3 -> no commit even with full match."""
+    s = with_log(base_state(), 0, [1, 1])
+    s = make_leader(s, 0, 3)
+    s = s._replace(match_index=s.match_index.at[0].set(jnp.full((5,), 2, jnp.int32)))
+    s2, _ = step(CFG, s)
+    assert int(s2.commit_index[0]) == 0
+
+
+# ----------------------------------------------------------------- timers & elections
+
+
+def test_timeout_starts_election():
+    cfg = CFG
+    s = base_state()
+    s = s._replace(deadline=s.deadline.at[2].set(1))  # expires on this tick
+    inp = quiet_inputs(cfg, far=20)
+    s2, _ = step(cfg, s, inp)
+    assert int(s2.role[2]) == CANDIDATE
+    assert int(s2.term[2]) == 2
+    assert int(s2.voted_for[2]) == 2
+    assert bool(s2.votes[2, 2])
+    for p in [0, 1, 3, 4]:
+        assert int(s2.mailbox.req_type[p, 2]) == REQ_VOTE
+        assert int(s2.mailbox.req_term[p, 2]) == 2
+
+
+def test_leader_heartbeats_on_timer():
+    s = with_log(base_state(), 0, [1])
+    s = make_leader(s, 0, 1)
+    # Peers haven't acked entry 1 yet: nextIndex = 1 -> the heartbeat ships it.
+    s = s._replace(
+        deadline=s.deadline.at[0].set(1),
+        next_index=s.next_index.at[0].set(jnp.ones((5,), jnp.int32)),
+    )
+    s2, _ = step(CFG, s)
+    for p in range(1, 5):
+        assert int(s2.mailbox.req_type[p, 0]) == REQ_APPEND
+        assert int(s2.mailbox.req_n_ent[p, 0]) == 1
+    assert int(s2.deadline[0]) == int(s2.clock[0]) + CFG.heartbeat_ticks
+
+
+def test_dropped_messages_are_dropped():
+    """deliver_mask=False edges deliver nothing (the reference's swallowed HTTP
+    exception, client.clj:38-40)."""
+    s = base_state()
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[1, 0].set(5),
+    )
+    inp = quiet_inputs(CFG)
+    inp = inp._replace(deliver_mask=inp.deliver_mask.at[1, 0].set(False))
+    s2, _ = step(CFG, s._replace(mailbox=mb), inp)
+    assert int(s2.term[1]) == 1  # nothing adopted
+    assert int(s2.mailbox.resp_type[0, 1]) == 0  # no response
+
+
+def test_client_command_lands_on_leader_only():
+    s = make_leader(base_state(), 0, 1)
+    inp = quiet_inputs(CFG)._replace(client_cmd=jnp.int32(42))
+    s2, _ = step(CFG, s, inp)
+    assert int(s2.log_len[0]) == 1
+    assert int(s2.log_val[0, 0]) == 42
+    assert all(int(x) == 0 for x in np.asarray(s2.log_len[1:]))

@@ -1,0 +1,74 @@
+"""Kernel-vs-oracle parity: the vectorized `where`-lattice in models/raft.py must agree
+bit-for-bit, tick by tick, with the scalar Python oracle (tests/oracle.py) across
+randomized trajectories including faults -- the mitigation SURVEY.md section 7.3 calls
+for against branch-precedence bugs."""
+
+import jax
+import numpy as np
+import pytest
+
+from raft_sim_tpu import RaftConfig, init_state
+from raft_sim_tpu.models import raft
+from raft_sim_tpu.sim import faults
+from tests import oracle
+
+
+def assert_state_equal(got: dict, want: dict, tick: int):
+    for f, g in got.items():
+        if f == "mailbox":
+            for mf, mg in g.items():
+                np.testing.assert_array_equal(
+                    mg, want["mailbox"][mf], err_msg=f"tick {tick}: mailbox.{mf}"
+                )
+        else:
+            np.testing.assert_array_equal(g, want[f], err_msg=f"tick {tick}: {f}")
+
+
+CONFIGS = [
+    pytest.param(RaftConfig(n_nodes=3, log_capacity=8, client_interval=3), 0, id="n3"),
+    pytest.param(
+        RaftConfig(n_nodes=5, log_capacity=8, max_entries_per_rpc=2, client_interval=2),
+        1,
+        id="n5-narrow-rpc",
+    ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=6,  # tiny: exercises capacity clipping
+            client_interval=1,
+            drop_prob=0.25,
+            clock_skew_prob=0.2,
+        ),
+        2,
+        id="n5-faults",
+    ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=4,  # even cluster size: quorum = 3
+            log_capacity=8,
+            client_interval=4,
+            drop_prob=0.15,
+            partition_period=10,
+            partition_prob=0.7,
+        ),
+        3,
+        id="n4-partitions",
+    ),
+]
+
+
+@pytest.mark.parametrize("cfg,seed", CONFIGS)
+def test_trajectory_parity(cfg, seed):
+    key = jax.random.key(seed)
+    k_init, k_run = jax.random.split(key)
+    state = init_state(cfg, k_init)
+    step = jax.jit(lambda s, i: raft.step(cfg, s, i)[0])
+
+    s_oracle = oracle.state_to_dict(state)
+    ticks = 150
+    for t in range(ticks):
+        inp = faults.make_inputs(cfg, k_run, state.now)
+        inp_np = {f: np.asarray(v) for f, v in zip(inp._fields, inp)}
+        state = step(state, inp)
+        s_oracle = oracle.oracle_step(cfg, s_oracle, inp_np)
+        assert_state_equal(oracle.state_to_dict(state), s_oracle, t)

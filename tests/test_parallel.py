@@ -1,0 +1,48 @@
+"""Multi-chip tier on the 8-virtual-CPU-device mesh (conftest.py sets
+xla_force_host_platform_device_count=8; SURVEY.md section 4, distributed tests)."""
+
+import jax
+import numpy as np
+
+from raft_sim_tpu import RaftConfig
+from raft_sim_tpu.parallel import make_mesh, simulate_sharded, summarize
+from raft_sim_tpu.sim import scan
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_matches_single_device():
+    """Same (seed, batch) must produce bit-identical trajectories at any device count
+    (SURVEY.md section 4: vmap/pmap parity)."""
+    cfg = RaftConfig(n_nodes=5, client_interval=8)
+    batch, ticks = 64, 120
+
+    f1, m1 = scan.simulate(cfg, 3, batch, ticks)
+    mesh = make_mesh()
+    f8, m8 = simulate_sharded(cfg, 3, batch, ticks, mesh)
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(m1)), jax.tree.leaves(jax.device_get(m8))):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(f1)), jax.tree.leaves(jax.device_get(f8))):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_output_is_sharded():
+    cfg = RaftConfig(n_nodes=3)
+    mesh = make_mesh()
+    final, metrics = simulate_sharded(cfg, 0, 16, 30, mesh)
+    shard_devs = {s.device for s in final.role.addressable_shards}
+    assert len(shard_devs) == 8
+
+
+def test_summarize_under_faults():
+    cfg = RaftConfig(n_nodes=5, drop_prob=0.2)
+    mesh = make_mesh()
+    _, metrics = simulate_sharded(cfg, 1, 64, 200, mesh)
+    s = summarize(metrics)
+    assert s.n_clusters == 64
+    assert s.total_violations == 0
+    # Most clusters should still stabilize under 20% drop.
+    assert s.n_stable > 32
