@@ -1,0 +1,3 @@
+from raft_sim_tpu.driver import main
+
+raise SystemExit(main())

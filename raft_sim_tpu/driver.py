@@ -1,0 +1,220 @@
+"""Host driver: CLI + REPL workflow for the simulator.
+
+The reference's dev loop is the Stuart Sierra "reloaded" REPL -- init/start/stop/go/
+reset building a component system (dev/user.clj:13-29) -- and its CLI is
+`lein run <self-id> <peer-id>...` (core.clj:197-203). The rebuild's equivalent is a
+`Session` with the same verbs (init/run/reset) plus a `backend` option selecting
+cpu|tpu (the north star's `:backend :tpu`), and a CLI:
+
+    python -m raft_sim_tpu run --preset config1 --ticks 10000
+    python -m raft_sim_tpu run --n-nodes 7 --batch 4096 --drop-prob 0.2 --summary
+    python -m raft_sim_tpu run --preset config1 --trace-events --trace-cluster 0
+    python -m raft_sim_tpu presets
+
+Unlike the reference (one OS process per node, topology from argv), one process drives
+every node of every simulated cluster; "topology" is just --n-nodes/--batch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from raft_sim_tpu import init_batch
+from raft_sim_tpu.sim import chunked, scan, trace
+from raft_sim_tpu.utils import checkpoint
+from raft_sim_tpu.utils.config import PRESETS, RaftConfig
+
+
+def select_backend(backend: str) -> None:
+    """Pick the JAX platform before any computation (north-star `:backend` option)."""
+    if backend != "auto":
+        jax.config.update("jax_platforms", backend)
+
+
+class Session:
+    """REPL-friendly driver: the dev/user.clj workflow verbs over the simulator.
+
+    >>> s = Session(RaftConfig(n_nodes=5, client_interval=8), batch=16, seed=0)
+    >>> s.run(1000)        # scan forward, accumulating metrics
+    >>> s.summary()        # fleet rollup dict
+    >>> s.reset()          # back to tick 0 with the same seed (user/reset)
+    """
+
+    def __init__(self, cfg: RaftConfig, batch: int = 1, seed: int = 0):
+        self.cfg = cfg
+        self.batch = batch
+        self.seed = seed
+        self.reset()
+
+    def reset(self) -> None:
+        """Rebuild initial state from the seed (the reference's user/reset, minus code
+        reloading, which Python REPLs handle themselves)."""
+        root = jax.random.key(self.seed)
+        k_init, k_run = jax.random.split(root)
+        self.state = init_batch(self.cfg, k_init, self.batch)
+        self.keys = jax.random.split(k_run, self.batch)
+        self.metrics = scan.init_metrics_batch(self.batch)
+
+    def run(self, n_ticks: int, chunk: int = 4096, progress: bool = False) -> None:
+        def cb(done, _state, metrics):
+            if progress:
+                v = int(np.sum(np.asarray(metrics.violations)))
+                print(f"  {done}/{n_ticks} ticks, violations={v}", file=sys.stderr)
+            return False
+
+        self.state, m = chunked.run_chunked(
+            self.cfg, self.state, self.keys, n_ticks, chunk=chunk, callback=cb
+        )
+        self.metrics = jax.vmap(chunked.merge_metrics)(self.metrics, m)
+
+    def trace(self, n_ticks: int, cluster: int = 0):
+        """Step a single selected cluster with full per-tick info + states captured
+        (heavy; debugging only). Does not advance the session."""
+        one = jax.tree.map(lambda x: x[cluster], self.state)
+        _, _, outs = _traced_run(self.cfg, n_ticks)(one, self.keys[cluster])
+        return outs  # (stacked StepInfo, stacked states)
+
+    def summary(self) -> dict:
+        from raft_sim_tpu.parallel import summarize
+
+        s = summarize(self.metrics)
+        return s._asdict()
+
+    def save(self, path: str) -> None:
+        checkpoint.save(path, self.cfg, self.state, self.keys, self.metrics)
+
+    @classmethod
+    def restore(cls, path: str, seed: int = 0) -> "Session":
+        """Resume exactly: state, keys, AND accumulated metrics come back, so summary()
+        after more run() calls matches a never-interrupted session."""
+        cfg, state, keys, metrics = checkpoint.load(path)
+        self = cls.__new__(cls)
+        self.cfg = cfg
+        self.batch = state.role.shape[0]
+        self.seed = seed
+        self.state = state
+        self.keys = keys
+        self.metrics = metrics
+        return self
+
+
+@functools.lru_cache(maxsize=8)
+def _traced_run(cfg: RaftConfig, n_ticks: int):
+    return jax.jit(lambda s, k: scan.run(cfg, s, k, n_ticks, trace_states=True))
+
+
+_FLAG_TYPES = {"int": int, "float": float}
+
+
+def _add_config_flags(p: argparse.ArgumentParser) -> None:
+    """One CLI flag per RaftConfig field (field types are strings under
+    `from __future__ import annotations`)."""
+    for f in dataclasses.fields(RaftConfig):
+        flag = "--" + f.name.replace("_", "-")
+        if f.type == "bool":
+            p.add_argument(flag, type=lambda s: s.lower() in ("1", "true", "yes"),
+                           default=None, metavar="BOOL")
+        else:
+            p.add_argument(flag, type=_FLAG_TYPES[f.type], default=None)
+
+
+def build_config(args) -> RaftConfig:
+    if args.preset:
+        cfg, preset_batch = PRESETS[args.preset]
+        if args.batch is None:
+            args.batch = preset_batch
+    else:
+        cfg = RaftConfig()
+    overrides = {
+        f.name: getattr(args, f.name)
+        for f in dataclasses.fields(RaftConfig)
+        if getattr(args, f.name) is not None
+    }
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="raft_sim_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser("run", help="simulate a batch of clusters")
+    run_p.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    run_p.add_argument("--batch", type=int, default=None)
+    run_p.add_argument("--ticks", type=int, default=1000)
+    run_p.add_argument("--seed", type=int, default=0)
+    run_p.add_argument("--chunk", type=int, default=4096)
+    run_p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto")
+    run_p.add_argument("--progress", action="store_true")
+    run_p.add_argument("--trace-ticks", type=int, default=0,
+                       help="print per-tick info lines for one cluster")
+    run_p.add_argument("--trace-events", action="store_true",
+                       help="print decoded state-change events for one cluster")
+    run_p.add_argument("--trace-cluster", type=int, default=0)
+    run_p.add_argument("--save", metavar="PATH", help="write a checkpoint at the end")
+    run_p.add_argument("--resume", metavar="PATH", help="start from a checkpoint")
+    _add_config_flags(run_p)
+
+    sub.add_parser("presets", help="list the BASELINE config presets")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "presets":
+        for name, (cfg, batch) in sorted(PRESETS.items()):
+            print(f"{name}: batch={batch} {cfg}")
+        return 0
+
+    select_backend(args.backend)
+    if args.resume:
+        # A checkpoint IS the config; silently rerunning it under different flags
+        # would mislabel the results.
+        conflicting = [
+            f.name for f in dataclasses.fields(RaftConfig)
+            if getattr(args, f.name) is not None
+        ]
+        if args.preset:
+            conflicting.append("preset")
+        if args.batch is not None:
+            conflicting.append("batch")
+        if conflicting:
+            ap.error(f"--resume is exclusive with config flags: {', '.join(conflicting)}")
+        sess = Session.restore(args.resume, seed=args.seed)
+    else:
+        cfg = build_config(args)
+        sess = Session(cfg, batch=args.batch if args.batch is not None else 1, seed=args.seed)
+
+    if args.trace_ticks or args.trace_events:
+        n = args.trace_ticks or args.ticks
+        infos, states = sess.trace(n, cluster=args.trace_cluster)
+        if args.trace_events:
+            for t, ev in trace.events(states):
+                print(f"tick {t:>6}  {ev}")
+        else:
+            for line in trace.info_lines(infos):
+                print(line)
+        return 0
+
+    t0 = time.perf_counter()
+    sess.run(args.ticks, chunk=args.chunk, progress=args.progress)
+    jax.block_until_ready(sess.state)
+    dt = time.perf_counter() - t0
+
+    out = sess.summary()
+    out["wall_s"] = round(dt, 3)
+    out["cluster_ticks_per_s"] = round(sess.batch * args.ticks / dt, 1)
+    print(json.dumps(out))
+
+    if args.save:
+        sess.save(args.save)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

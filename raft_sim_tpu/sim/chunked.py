@@ -1,0 +1,69 @@
+"""Long-horizon runs: chunked scans with periodic host offload.
+
+The trajectory axis (simulated time) is one of the two "long axes" the rebuild scales
+without materializing (SURVEY.md section 5, long-context analogue): a 10M-tick fuzz run
+must not stack 10M StepInfos on device. `run_chunked` scans in fixed-size jitted chunks
+and merges the small per-chunk RunMetrics on the way, optionally invoking a host
+callback between chunks (progress reporting, checkpointing, early abort on violation).
+
+Metric merge works because `scan._accumulate` records absolute tick numbers (state.now),
+which persist across chunk boundaries in the carry.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu.sim import scan
+from raft_sim_tpu.types import ClusterState
+from raft_sim_tpu.utils.config import RaftConfig
+
+
+def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
+    """Combine metrics of two consecutive run segments (a then b)."""
+    return scan.RunMetrics(
+        violations=a.violations + b.violations,
+        first_leader_tick=jnp.minimum(a.first_leader_tick, b.first_leader_tick),
+        last_leaderless_tick=jnp.maximum(a.last_leaderless_tick, b.last_leaderless_tick),
+        max_term=jnp.maximum(a.max_term, b.max_term),
+        max_commit=jnp.maximum(a.max_commit, b.max_commit),
+        min_commit=b.min_commit,  # "at final tick" -> later segment wins
+        total_msgs=a.total_msgs + b.total_msgs,
+        ticks=a.ticks + b.ticks,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _chunk(cfg: RaftConfig, state: ClusterState, keys: jax.Array, n: int):
+    return scan.run_batch(cfg, state, keys, n)
+
+
+def run_chunked(
+    cfg: RaftConfig,
+    state: ClusterState,
+    keys: jax.Array,
+    n_ticks: int,
+    chunk: int = 1024,
+    callback: Callable[[int, ClusterState, scan.RunMetrics], bool] | None = None,
+):
+    """Scan a batched state forward `n_ticks` in jitted chunks.
+
+    `callback(ticks_done, state, merged_metrics)` runs between chunks; returning True
+    stops early (e.g. on a violation during invariant fuzzing). Returns
+    (final_state, merged_metrics).
+    """
+    batch = state.role.shape[0]
+    metrics = scan.init_metrics_batch(batch)
+    done = 0
+    while done < n_ticks:
+        n = min(chunk, n_ticks - done)
+        state, m, _ = _chunk(cfg, state, keys, n)
+        metrics = jax.vmap(merge_metrics)(metrics, m)
+        done += n
+        if callback is not None and callback(done, state, metrics):
+            break
+    return state, metrics

@@ -50,6 +50,11 @@ class RunMetrics(NamedTuple):
     ticks: jax.Array  # int32
 
 
+def init_metrics_batch(batch: int) -> RunMetrics:
+    """Zeroed RunMetrics with a leading [batch] axis (the run_batch/driver carry)."""
+    return jax.vmap(lambda _: init_metrics())(jnp.arange(batch))
+
+
 def init_metrics() -> RunMetrics:
     z = jnp.int32(0)
     return RunMetrics(

@@ -1,0 +1,71 @@
+"""Host-side trajectory decoding -- the TPU-native equivalent of the reference's
+per-iteration println of node state + message (core.clj:182-186).
+
+On device, tracing is just `scan.run(..., trace=True / trace_states=True)`: the scan
+stacks per-tick StepInfo (cheap) or full ClusterStates (heavy, debug only) as a
+trajectory. This module renders those stacks for one selected cluster as human-readable
+lines, and diffs consecutive states into discrete events (elections started, votes
+granted, leaders crowned, entries committed) so a failing fuzz case can be read like
+the reference's console output.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from raft_sim_tpu.types import CANDIDATE, FOLLOWER, LEADER, NIL
+
+ROLE_NAMES = {FOLLOWER: "follower", CANDIDATE: "candidate", LEADER: "leader"}
+
+
+def info_lines(infos, every: int = 1) -> Iterator[str]:
+    """Render stacked StepInfo (single cluster: leading axis = ticks) as one line per
+    `every` ticks."""
+    # Pull every field host-side once; per-tick indexing below is then pure numpy.
+    f = {name: np.asarray(getattr(infos, name)) for name in infos._fields}
+    viol = f["viol_election_safety"] | f["viol_commit"] | f["viol_log_matching"]
+    for t in range(0, len(f["leader"]), every):
+        leader = int(f["leader"][t])
+        yield (
+            f"tick {t:>6}  leader={'-' if leader == NIL else leader}"
+            f"  n_leaders={int(f['n_leaders'][t])}"
+            f"  max_term={int(f['max_term'][t])}"
+            f"  commit[{int(f['min_commit'][t])},{int(f['max_commit'][t])}]"
+            f"  msgs={int(f['msgs_delivered'][t])}"
+            + ("  VIOLATION" if bool(viol[t]) else "")
+        )
+
+
+def node_line(states, t: int, node: int) -> str:
+    """One node's state at tick t (stacked states, single cluster) -- the analogue of
+    the reference's `(println node)` (core.clj:183)."""
+    g = lambda f: np.asarray(getattr(states, f))[t, node]
+    role = ROLE_NAMES[int(g("role"))]
+    vf, ld = int(g("voted_for")), int(g("leader_id"))
+    return (
+        f"  node {node}: {role:<9} term={int(g('term'))}"
+        f" voted_for={'-' if vf == NIL else vf}"
+        f" leader={'-' if ld == NIL else ld}"
+        f" commit={int(g('commit_index'))} log_len={int(g('log_len'))}"
+        f" clock={int(g('clock'))}/{int(g('deadline'))}"
+    )
+
+
+def events(states) -> Iterator[tuple[int, str]]:
+    """Diff consecutive stacked states (single cluster) into (tick, event) pairs."""
+    role = np.asarray(states.role)
+    term = np.asarray(states.term)
+    commit = np.asarray(states.commit_index)
+    n_ticks, n = role.shape
+    for t in range(1, n_ticks):
+        for i in range(n):
+            if role[t, i] == CANDIDATE and role[t - 1, i] != CANDIDATE:
+                yield t, f"node {i} starts election for term {term[t, i]}"
+            if role[t, i] == LEADER and role[t - 1, i] != LEADER:
+                yield t, f"node {i} becomes leader of term {term[t, i]}"
+            if role[t, i] != LEADER and role[t - 1, i] == LEADER:
+                yield t, f"node {i} steps down (term {term[t - 1, i]} -> {term[t, i]})"
+            if commit[t, i] > commit[t - 1, i]:
+                yield t, f"node {i} commits through {commit[t, i]}"

@@ -1,0 +1,70 @@
+"""Simulator checkpoint/resume.
+
+The reference's only persistence is an append-only file of committed values that is
+never read back (log.clj:16-18, 74-75 -- no real resume, SURVEY.md section 5). Here the
+checkpoint is the full simulator state: every ClusterState array plus the per-cluster
+PRNG keys and the config, so a long fuzz run resumes bit-exactly (inputs are pure
+functions of (key, state.now), faults.py, so no RNG stream state needs saving beyond
+the keys themselves).
+
+The accumulated RunMetrics ride along too: `last_leaderless_tick`/`first_leader_tick`
+record *absolute* tick numbers (state.now), so metric accumulation only stays coherent
+across a resume if the pre-checkpoint metrics are restored with the state.
+
+Format: a single .npz with the config as a JSON string; loads with numpy only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+
+from raft_sim_tpu.sim.scan import RunMetrics
+from raft_sim_tpu.types import ClusterState, Mailbox
+from raft_sim_tpu.utils.config import RaftConfig
+
+_FORMAT_VERSION = 1
+
+
+def save(
+    path: str,
+    cfg: RaftConfig,
+    state: ClusterState,
+    keys: jax.Array,
+    metrics: RunMetrics,
+) -> None:
+    """Write (config, batched state, per-cluster run keys, accumulated metrics)."""
+    arrays = {f"state_{f}": np.asarray(v) for f, v in zip(state._fields, state) if f != "mailbox"}
+    arrays |= {f"mb_{f}": np.asarray(v) for f, v in zip(state.mailbox._fields, state.mailbox)}
+    arrays |= {f"metrics_{f}": np.asarray(v) for f, v in zip(metrics._fields, metrics)}
+    arrays["keys"] = np.asarray(jax.random.key_data(keys))
+    np.savez_compressed(
+        path,
+        __version__=np.int32(_FORMAT_VERSION),
+        config_json=np.bytes_(json.dumps(dataclasses.asdict(cfg)).encode()),
+        **arrays,
+    )
+
+
+def load(path: str) -> tuple[RaftConfig, ClusterState, jax.Array, RunMetrics]:
+    """Read a checkpoint; returns (cfg, state, keys, metrics) ready to resume."""
+    with np.load(path) as z:
+        version = int(z["__version__"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(f"checkpoint format {version}, expected {_FORMAT_VERSION}")
+        cfg = RaftConfig(**json.loads(bytes(z["config_json"]).decode()))
+        mb = Mailbox(**{f: jax.numpy.asarray(z[f"mb_{f}"]) for f in Mailbox._fields})
+        fields = {
+            f: jax.numpy.asarray(z[f"state_{f}"])
+            for f in ClusterState._fields
+            if f != "mailbox"
+        }
+        state = ClusterState(mailbox=mb, **fields)
+        keys = jax.random.wrap_key_data(jax.numpy.asarray(z["keys"]))
+        metrics = RunMetrics(
+            **{f: jax.numpy.asarray(z[f"metrics_{f}"]) for f in RunMetrics._fields}
+        )
+    return cfg, state, keys, metrics
