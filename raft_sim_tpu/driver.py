@@ -73,11 +73,13 @@ class Session:
         self.state, m = chunked.run_chunked(
             self.cfg, self.state, self.keys, n_ticks, chunk=chunk, callback=cb
         )
-        self.metrics = jax.vmap(chunked.merge_metrics)(self.metrics, m)
+        self.metrics = chunked.merge_metrics(self.metrics, m)
 
     def trace(self, n_ticks: int, cluster: int = 0):
         """Step a single selected cluster with full per-tick info + states captured
         (heavy; debugging only). Does not advance the session."""
+        if not 0 <= cluster < self.batch:
+            raise IndexError(f"cluster {cluster} out of range for batch {self.batch}")
         one = jax.tree.map(lambda x: x[cluster], self.state)
         _, _, outs = _traced_run(self.cfg, n_ticks)(one, self.keys[cluster])
         return outs  # (stacked StepInfo, stacked states)
@@ -88,14 +90,17 @@ class Session:
         s = summarize(self.metrics)
         return s._asdict()
 
-    def save(self, path: str) -> None:
-        checkpoint.save(path, self.cfg, self.state, self.keys, self.metrics)
+    def save(self, path: str) -> str:
+        return checkpoint.save(
+            path, self.cfg, self.state, self.keys, self.metrics, seed=self.seed
+        )
 
     @classmethod
-    def restore(cls, path: str, seed: int = 0) -> "Session":
-        """Resume exactly: state, keys, AND accumulated metrics come back, so summary()
-        after more run() calls matches a never-interrupted session."""
-        cfg, state, keys, metrics = checkpoint.load(path)
+    def restore(cls, path: str) -> "Session":
+        """Resume exactly: state, keys, accumulated metrics, AND the original seed come
+        back, so summary() after more run() calls matches a never-interrupted session
+        and reset() rebuilds the same experiment."""
+        cfg, state, keys, metrics, seed = checkpoint.load(path)
         self = cls.__new__(cls)
         self.cfg = cfg
         self.batch = state.role.shape[0]
@@ -126,19 +131,20 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
             p.add_argument(flag, type=_FLAG_TYPES[f.type], default=None)
 
 
-def build_config(args) -> RaftConfig:
+def build_config(args) -> tuple[RaftConfig, int]:
+    """(config, batch) from preset + CLI overrides; batch falls back preset -> 1."""
+    preset_batch = 1
     if args.preset:
         cfg, preset_batch = PRESETS[args.preset]
-        if args.batch is None:
-            args.batch = preset_batch
     else:
         cfg = RaftConfig()
+    batch = args.batch if args.batch is not None else preset_batch
     overrides = {
         f.name: getattr(args, f.name)
         for f in dataclasses.fields(RaftConfig)
         if getattr(args, f.name) is not None
     }
-    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+    return (dataclasses.replace(cfg, **overrides) if overrides else cfg), batch
 
 
 def main(argv=None) -> int:
@@ -185,12 +191,15 @@ def main(argv=None) -> int:
             conflicting.append("batch")
         if conflicting:
             ap.error(f"--resume is exclusive with config flags: {', '.join(conflicting)}")
-        sess = Session.restore(args.resume, seed=args.seed)
+        sess = Session.restore(args.resume)
     else:
-        cfg = build_config(args)
-        sess = Session(cfg, batch=args.batch if args.batch is not None else 1, seed=args.seed)
+        cfg, batch = build_config(args)
+        sess = Session(cfg, batch=batch, seed=args.seed)
 
     if args.trace_ticks or args.trace_events:
+        if args.save:
+            ap.error("--save has no effect with --trace-ticks/--trace-events "
+                     "(tracing does not advance the session)")
         n = args.trace_ticks or args.ticks
         infos, states = sess.trace(n, cluster=args.trace_cluster)
         if args.trace_events:

@@ -1,0 +1,340 @@
+"""Batch-minor Raft tick kernel: the hot path for TPU execution.
+
+Semantics are EXACTLY models/raft.py (same nine phases, same citations) -- this module
+exists purely for memory layout. The vmap form puts the cluster batch LEADING
+([B, N, ...]), which leaves each array's two minor dims at (N, N) or (N, CAP); TPU
+tiles the two minor dims to (8, 128), so a [B, 5, 5] int32 array physically occupies
+~40x its logical bytes and every tick is HBM-bound on padding (measured ~700KB moved
+per cluster-tick vs ~3KB of logical state). Here the batch axis B is MINOR on every
+array ([N, B], [N, N, B], [N, CAP, B]), so B rides the 128-wide lane tile and padding
+is bounded by the second-minor dim (N or E or CAP -> at most 8/5).
+
+Parity with the vmap form is enforced bit-for-bit by tests/test_batched_parity.py;
+parity with the scalar oracle therefore transfers. Keep the two kernels in sync: any
+semantic change lands in raft.py first (with its unit tests), then here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu.ops import log_ops
+from raft_sim_tpu.types import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    NIL,
+    REQ_APPEND,
+    REQ_VOTE,
+    RESP_APPEND,
+    RESP_VOTE,
+    ClusterState,
+    Mailbox,
+    StepInfo,
+    StepInputs,
+)
+from raft_sim_tpu.utils.config import RaftConfig
+
+
+def to_batch_minor(tree):
+    """[B, ...]-leading pytree -> [..., B]-trailing (transpose once per run, not per
+    tick)."""
+    return jax.tree.map(lambda x: jnp.moveaxis(x, 0, -1), tree)
+
+
+def from_batch_minor(tree):
+    return jax.tree.map(lambda x: jnp.moveaxis(x, -1, 0), tree)
+
+
+def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
+    """One tick for B clusters at once; every array carries a trailing batch axis.
+
+    Mirrors raft.step phase by phase; see that function for the reference citations.
+    """
+    n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
+    b = s.role.shape[-1]
+    mb = s.mailbox
+    ids = jnp.arange(n, dtype=jnp.int32)
+    eye3 = jnp.eye(n, dtype=bool)[:, :, None]  # [N, N, 1]
+    src_ids = jnp.broadcast_to(ids[None, :, None], (n, n, 1))  # [dst, src, 1] -> src id
+
+    # ---- phase 0: delivery -------------------------------------------------------
+    deliver = inp.deliver_mask & ~eye3  # [N, N, B]
+    req_in = deliver & (mb.req_type != 0)
+    resp_in = deliver & (mb.resp_type != 0)
+
+    # ---- phase 1: term adoption --------------------------------------------------
+    in_term = jnp.maximum(
+        jnp.max(jnp.where(req_in, mb.req_term, 0), axis=1),
+        jnp.max(jnp.where(resp_in, mb.resp_term, 0), axis=1),
+    )  # [N, B]
+    saw_higher = in_term > s.term
+    term = jnp.maximum(s.term, in_term)
+    role = jnp.where(saw_higher, FOLLOWER, s.role)
+    voted_for = jnp.where(saw_higher, NIL, s.voted_for)
+    leader_id = jnp.where(saw_higher, NIL, s.leader_id)
+    votes = s.votes & ~saw_higher[:, None, :]
+
+    my_last_idx, my_last_term = log_ops.last_index_term_b(s.log_term, s.log_len)
+
+    # ---- phase 2: RequestVote requests -------------------------------------------
+    is_rv = req_in & (mb.req_type == REQ_VOTE)
+    cur_rv = is_rv & (mb.req_term == term[:, None, :])
+    up_to_date = (mb.req_prev_term > my_last_term[:, None, :]) | (
+        (mb.req_prev_term == my_last_term[:, None, :])
+        & (mb.req_prev_index >= my_last_idx[:, None, :])
+    )
+    can_grant = cur_rv & up_to_date
+    lowest = jnp.min(jnp.where(can_grant, src_ids, n), axis=1)  # [N, B]
+    grant = jnp.where(
+        (voted_for != NIL)[:, None, :],
+        can_grant & (src_ids == voted_for[:, None, :]),
+        can_grant & (src_ids == lowest[:, None, :]),
+    )
+    granted_any = jnp.any(grant, axis=1)  # [N, B]
+    voted_for = jnp.where((voted_for == NIL) & granted_any, lowest, voted_for)
+    vr_out = is_rv
+    vr_granted = grant
+
+    # ---- phase 3: AppendEntries requests ------------------------------------------
+    is_ae = req_in & (mb.req_type == REQ_APPEND)
+    cur_ae = is_ae & (mb.req_term == term[:, None, :])
+    ae_src = jnp.min(jnp.where(cur_ae, src_ids, n), axis=1)  # [N, B]
+    has_ae = ae_src < n
+    sel = cur_ae & (src_ids == ae_src[:, None, :])  # one-hot [dst, src, B]
+
+    pick = lambda f: jnp.sum(jnp.where(sel, f, 0), axis=1)  # [N, B]
+    prev_i = pick(mb.req_prev_index)
+    prev_t = pick(mb.req_prev_term)
+    lcommit = pick(mb.req_commit)
+    n_ent = pick(mb.req_n_ent)
+    # Select the chosen sender's entry window via the same one-hot reduction (no
+    # gather; when no sender is selected the window is zeros, and every downstream use
+    # is masked by n_ent/ae_ok): [N(dst), N(src), E, B] -> [N, E, B].
+    ent_term_in = jnp.sum(jnp.where(sel[:, :, None, :], mb.req_ent_term, 0), axis=1)
+    ent_val_in = jnp.sum(jnp.where(sel[:, :, None, :], mb.req_ent_val, 0), axis=1)
+
+    role = jnp.where(has_ae & (role == CANDIDATE), FOLLOWER, role)
+    leader_id = jnp.where(has_ae, ae_src, leader_id)
+
+    prev_stored_term = log_ops.term_at_b(s.log_term, prev_i)
+    consistent = (prev_i == 0) | ((prev_i <= s.log_len) & (prev_stored_term == prev_t))
+    ae_ok = has_ae & consistent
+
+    ks = jnp.arange(e, dtype=jnp.int32)
+    gidx0 = prev_i[:, None, :] + ks[None, :, None]  # [N, E, B] 0-based slots
+    in_ent = ks[None, :, None] < n_ent[:, None, :]
+    exists = gidx0 < s.log_len[:, None, :]
+    stored = log_ops.window_b(s.log_term, prev_i, e)  # [N, E, B]
+    mismatch = in_ent & exists & (stored != ent_term_in)
+    any_mismatch = jnp.any(mismatch, axis=1)  # [N, B]
+    appended_len = jnp.minimum(prev_i + n_ent, cap)
+    new_len = jnp.where(any_mismatch, appended_len, jnp.maximum(s.log_len, appended_len))
+    log_len = jnp.where(ae_ok, new_len, s.log_len)
+    wmask = ae_ok[:, None, :] & in_ent
+    log_term_arr = log_ops.write_window_b(s.log_term, prev_i, ent_term_in, wmask)
+    log_val_arr = log_ops.write_window_b(s.log_val, prev_i, ent_val_in, wmask)
+
+    last_new = jnp.minimum(prev_i + n_ent, log_len)
+    commit = jnp.where(
+        ae_ok,
+        jnp.maximum(s.commit_index, jnp.minimum(lcommit, last_new)),
+        s.commit_index,
+    )
+
+    ar_out = is_ae
+    ar_success = sel & ae_ok[:, None, :]
+    ar_match = jnp.where(ar_success, last_new[:, None, :], 0)
+
+    # ---- phase 4: responses ------------------------------------------------------
+    vresp = resp_in & (mb.resp_type == RESP_VOTE)
+    new_votes = (
+        vresp
+        & mb.resp_ok
+        & (mb.resp_term == term[:, None, :])
+        & (role == CANDIDATE)[:, None, :]
+    )
+    votes = votes | new_votes
+    n_votes = jnp.sum(votes, axis=1).astype(jnp.int32)  # [N, B]
+    win = (role == CANDIDATE) & (n_votes >= cfg.quorum)
+    role = jnp.where(win, LEADER, role)
+    leader_id = jnp.where(win, ids[:, None], leader_id)
+    next_index = jnp.where(win[:, None, :], (log_len + 1)[:, None, :], s.next_index)
+    match_index = jnp.where(win[:, None, :], 0, s.match_index)
+
+    aresp = (
+        resp_in
+        & (mb.resp_type == RESP_APPEND)
+        & (role == LEADER)[:, None, :]
+        & (mb.resp_term == term[:, None, :])
+    )
+    a_succ = aresp & mb.resp_ok
+    a_fail = aresp & ~mb.resp_ok
+    match_index = jnp.where(a_succ, jnp.maximum(match_index, mb.resp_match), match_index)
+    next_index = jnp.where(a_succ, jnp.maximum(next_index, mb.resp_match + 1), next_index)
+    next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
+
+    # ---- phase 5: leader commit advancement --------------------------------------
+    is_leader = role == LEADER
+    match_with_self = jnp.where(eye3, log_len[:, None, :], match_index)  # [N, N, B]
+    # quorum-th largest match without a sort (TPU sorts along a non-minor axis are
+    # slow): value v qualifies iff #(matches >= v) >= quorum; the largest qualifying
+    # match equals the quorum-th order statistic. O(N^2) compares, all elementwise.
+    cnt_ge = jnp.sum(
+        (match_with_self[:, None, :, :] >= match_with_self[:, :, None, :]), axis=2
+    )  # [N(leader), N(j), B]: how many matches >= match_j
+    qualifies = cnt_ge >= cfg.quorum
+    quorum_match = jnp.max(jnp.where(qualifies, match_with_self, 0), axis=1)  # [N, B]
+    quorum_term = log_ops.term_at_b(log_term_arr, quorum_match)
+    commit = jnp.where(
+        is_leader & (quorum_match > commit) & (quorum_term == term),
+        quorum_match,
+        commit,
+    )
+
+    # ---- phase 6: client command injection ----------------------------------------
+    do_inject = (inp.client_cmd[None, :] != NIL) & is_leader & (log_len < cap)
+    inj_pos = jnp.where(do_inject, log_len, cap)  # [N, B]; cap matches no slot
+    cs = jnp.arange(cap, dtype=jnp.int32)
+    inj_oh = cs[None, :, None] == inj_pos[:, None, :]  # [N, CAP, B]
+    log_term_arr = jnp.where(inj_oh, term[:, None, :], log_term_arr)
+    log_val_arr = jnp.where(inj_oh, inp.client_cmd[None, None, :], log_val_arr)
+    log_len = log_len + do_inject
+
+    # ---- phase 7: timers ---------------------------------------------------------
+    clock = s.clock + inp.skew
+    reset_election = granted_any | has_ae | saw_higher
+    deadline = jnp.where(reset_election, clock + inp.timeout_draw, s.deadline)
+    deadline = jnp.where(win, clock + cfg.heartbeat_ticks, deadline)
+    expired = clock >= deadline
+
+    heartbeat = expired & is_leader
+    deadline = jnp.where(heartbeat, clock + cfg.heartbeat_ticks, deadline)
+
+    start_election = expired & ~is_leader
+    term = term + start_election
+    role = jnp.where(start_election, CANDIDATE, role)
+    voted_for = jnp.where(start_election, ids[:, None], voted_for)
+    leader_id = jnp.where(start_election, NIL, leader_id)
+    votes = jnp.where(start_election[:, None, :], eye3, votes)
+    deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
+
+    # ---- phase 8: outbox ---------------------------------------------------------
+    send_append = win | heartbeat
+    new_last_idx, new_last_term = log_ops.last_index_term_b(log_term_arr, log_len)
+
+    rv_edge = start_election[:, None, :] & ~eye3  # [src, dst, B]
+    ae_edge = send_append[:, None, :] & ~eye3
+    out_req_type = jnp.where(rv_edge, REQ_VOTE, jnp.where(ae_edge, REQ_APPEND, 0))
+    out_req_term = jnp.broadcast_to(term[:, None, :], (n, n, b))
+    prev_out = jnp.clip(next_index - 1, 0, log_len[:, None, :])  # [src, dst, B]
+    n_out = jnp.clip(log_len[:, None, :] - prev_out, 0, e)
+    out_prev_term_ae = log_ops.term_at_b(log_term_arr, prev_out)
+    out_req_prev_index = jnp.where(rv_edge, new_last_idx[:, None, :], prev_out)
+    out_req_prev_term = jnp.where(rv_edge, new_last_term[:, None, :], out_prev_term_ae)
+    out_req_commit = jnp.broadcast_to(commit[:, None, :], (n, n, b))
+    out_req_n_ent = jnp.where(ae_edge, n_out, 0)
+    ent_used = ks[None, None, :, None] < n_out[:, :, None, :]  # [src, dst, E, B]
+    out_ent_term = jnp.where(ent_used, log_ops.window_b(log_term_arr, prev_out, e), 0)
+    out_ent_val = jnp.where(ent_used, log_ops.window_b(log_val_arr, prev_out, e), 0)
+
+    tr = lambda x: jnp.swapaxes(x, 0, 1)  # [src, dst, B] <-> [dst, src, B]
+    out_resp_type = tr(
+        jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
+    )
+    out_resp_term = tr(jnp.broadcast_to(term[:, None, :], (n, n, b)))
+    out_resp_ok = tr(vr_granted | ar_success)
+    out_resp_match = tr(ar_match)
+
+    new_mb = Mailbox(
+        req_type=tr(out_req_type),
+        req_term=tr(jnp.where(out_req_type != 0, out_req_term, 0)),
+        req_prev_index=tr(jnp.where(out_req_type != 0, out_req_prev_index, 0)),
+        req_prev_term=tr(jnp.where(out_req_type != 0, out_req_prev_term, 0)),
+        req_commit=tr(jnp.where(ae_edge, out_req_commit, 0)),
+        req_n_ent=tr(out_req_n_ent),
+        req_ent_term=jnp.swapaxes(jnp.where(ae_edge[:, :, None, :], out_ent_term, 0), 0, 1),
+        req_ent_val=jnp.swapaxes(jnp.where(ae_edge[:, :, None, :], out_ent_val, 0), 0, 1),
+        resp_type=out_resp_type,
+        resp_term=jnp.where(out_resp_type != 0, out_resp_term, 0),
+        resp_ok=out_resp_ok,
+        resp_match=out_resp_match,
+    )
+
+    new_state = ClusterState(
+        role=role,
+        term=term,
+        voted_for=voted_for,
+        leader_id=leader_id,
+        votes=votes,
+        next_index=next_index,
+        match_index=match_index,
+        commit_index=commit,
+        log_term=log_term_arr,
+        log_val=log_val_arr,
+        log_len=log_len,
+        clock=clock,
+        deadline=deadline,
+        now=s.now + 1,
+        mailbox=new_mb,
+    )
+
+    info = _step_info_b(cfg, s, new_state, req_in, resp_in)
+    return new_state, info
+
+
+def _step_info_b(
+    cfg: RaftConfig,
+    old: ClusterState,
+    new: ClusterState,
+    req_in: jax.Array,
+    resp_in: jax.Array,
+) -> StepInfo:
+    """Batched phase 9; see raft._step_info. All outputs [B]."""
+    n = cfg.n_nodes
+    b = new.role.shape[-1]
+    eye3 = jnp.eye(n, dtype=bool)[:, :, None]
+    is_leader = new.role == LEADER
+    f = jnp.zeros((b,), bool)
+
+    if cfg.check_invariants:
+        pair_bad = (
+            is_leader[:, None, :]
+            & is_leader[None, :, :]
+            & (new.term[:, None, :] == new.term[None, :, :])
+            & ~eye3
+        )
+        viol_election = jnp.any(pair_bad, axis=(0, 1))
+        viol_commit = jnp.any(
+            (new.commit_index < old.commit_index) | (new.commit_index > new.log_len),
+            axis=0,
+        )
+    else:
+        viol_election = f
+        viol_commit = f
+
+    if cfg.check_log_matching:
+        minc = jnp.minimum(new.commit_index[:, None, :], new.commit_index[None, :, :])
+        ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
+        both = ks[None, None, :, None] < minc[:, :, None, :]
+        differ = new.log_term[:, None] != new.log_term[None, :]
+        viol_match = jnp.any(both & differ, axis=(0, 1, 2))
+    else:
+        viol_match = f
+
+    ids = jnp.arange(n, dtype=jnp.int32)
+    leader = jnp.min(jnp.where(is_leader, ids[:, None], n), axis=0)  # [B]
+    return StepInfo(
+        viol_election_safety=viol_election,
+        viol_commit=viol_commit,
+        viol_log_matching=viol_match,
+        leader=jnp.where(leader < n, leader, NIL).astype(jnp.int32),
+        n_leaders=jnp.sum(is_leader, axis=0).astype(jnp.int32),
+        max_term=jnp.max(new.term, axis=0),
+        max_commit=jnp.max(new.commit_index, axis=0),
+        min_commit=jnp.min(new.commit_index, axis=0),
+        msgs_delivered=(
+            jnp.sum(req_in, axis=(0, 1)) + jnp.sum(resp_in, axis=(0, 1))
+        ).astype(jnp.int32),
+    )

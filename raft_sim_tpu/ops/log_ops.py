@@ -81,3 +81,78 @@ def write_window(
     pos = jnp.where(mask, pos, cap)
     rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
     return arr.at[rows, pos].set(vals, mode="drop")
+
+
+# --------------------------------------------------------------------------------------
+# Batch-minor variants: identical semantics with a trailing batch axis B. The batch
+# rides the TPU lane dimension (128-wide minor tile), so these are the hot-path forms
+# (models/raft_batched.py); the unsuffixed single-cluster forms above stay as the
+# readable reference semantics used via vmap in debug/trace paths.
+#
+# No gather/scatter anywhere: with the batch minor, dynamic indices would vary along
+# the lane dimension, which TPU gathers serialize (measured ~5x slower than the whole
+# rest of the tick). CAP/E are small static dims, so every indexed access is instead a
+# one-hot compare-and-reduce over the indexed axis -- pure elementwise work that
+# vectorizes across lanes. Equality with the gather forms is pinned by
+# tests/test_batched_parity.py.
+# --------------------------------------------------------------------------------------
+
+
+def term_at_b(log_term: jax.Array, index1: jax.Array) -> jax.Array:
+    """Batched term_at. log_term: [N, CAP, B]; index1: [N, B] or [N, M, B].
+
+    index1 == 0 matches no slot and yields 0 (the "no entry" sentinel), like the
+    where(index1 > 0, ...) mask in the gather form.
+    """
+    cap = log_term.shape[1]
+    cs = jnp.arange(cap, dtype=jnp.int32)
+    if index1.ndim == 2:  # [N, B] -> [N, B]
+        oh = cs[None, :, None] == (index1 - 1)[:, None, :]  # [N, CAP, B]
+        return jnp.sum(jnp.where(oh, log_term, 0), axis=1)
+    # [N, M, B] -> [N, M, B]
+    oh = cs[None, None, :, None] == (index1 - 1)[:, :, None, :]  # [N, M, CAP, B]
+    return jnp.sum(jnp.where(oh, log_term[:, None], 0), axis=2)
+
+
+def last_index_term_b(log_term: jax.Array, log_len: jax.Array):
+    """Batched last_index_term. log_term: [N, CAP, B]; log_len: [N, B]."""
+    return log_len, term_at_b(log_term, log_len)
+
+
+def window_b(arr: jax.Array, start0: jax.Array, e: int) -> jax.Array:
+    """Batched window. arr: [N, CAP, B]; start0: [N, B] -> [N, E, B], or
+    [N, M, B] -> [N, M, E, B]. Out-of-range slots clamp to the last slot (callers mask
+    with an explicit count), matching the clipped gather form."""
+    cap = arr.shape[1]
+    cs = jnp.arange(cap, dtype=jnp.int32)
+    ks = jnp.arange(e, dtype=jnp.int32)
+    if start0.ndim == 2:  # [N, B]
+        pos = jnp.clip(start0[:, None, :] + ks[None, :, None], 0, cap - 1)  # [N, E, B]
+        oh = cs[None, None, :, None] == pos[:, :, None, :]  # [N, E, CAP, B]
+        return jnp.sum(jnp.where(oh, arr[:, None], 0), axis=2)
+    # [N, M, B]
+    pos = jnp.clip(start0[:, :, None, :] + ks[None, None, :, None], 0, cap - 1)
+    oh = cs[None, None, None, :, None] == pos[:, :, :, None, :]  # [N, M, E, CAP, B]
+    return jnp.sum(jnp.where(oh, arr[:, None, None], 0), axis=3)
+
+
+def write_window_b(
+    arr: jax.Array,
+    start0: jax.Array,
+    vals: jax.Array,
+    mask: jax.Array,
+) -> jax.Array:
+    """Batched write_window. arr: [N, CAP, B]; start0: [N, B]; vals/mask: [N, E, B].
+
+    Window positions are strictly increasing in k, so each capacity slot is hit by at
+    most one unmasked entry; masked entries are routed to position `cap`, which matches
+    no slot (the scatter form's mode='drop')."""
+    cap = arr.shape[1]
+    cs = jnp.arange(cap, dtype=jnp.int32)
+    ks = jnp.arange(vals.shape[1], dtype=jnp.int32)
+    pos = start0[:, None, :] + ks[None, :, None]  # [N, E, B]
+    pos = jnp.where(mask, pos, cap)
+    oh = cs[None, None, :, None] == pos[:, :, None, :]  # [N, E, CAP, B]
+    hit = jnp.any(oh, axis=1)  # [N, CAP, B]
+    val = jnp.sum(jnp.where(oh, vals[:, :, None, :], 0), axis=1)
+    return jnp.where(hit, val, arr)

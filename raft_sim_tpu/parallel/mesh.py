@@ -46,10 +46,10 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 
 
 def _run_shard(cfg: RaftConfig, n_ticks: int, keys_init, keys_run):
-    """Body executed per shard: init + scan the local slice of clusters."""
+    """Body executed per shard: init + scan the local slice of clusters (batch-minor
+    hot path)."""
     state = jax.vmap(lambda k: init_state(cfg, k))(keys_init)
-    final, metrics, _ = scan.run_batch(cfg, state, keys_run, n_ticks)
-    return final, metrics
+    return scan.run_batch_minor(cfg, state, keys_run, n_ticks)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2, 3, 4))

@@ -24,7 +24,8 @@ from raft_sim_tpu.utils.config import RaftConfig
 
 
 def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
-    """Combine metrics of two consecutive run segments (a then b)."""
+    """Combine metrics of two consecutive run segments (a then b). Every op is
+    elementwise, so this works unchanged on scalar or [batch]-shaped metrics."""
     return scan.RunMetrics(
         violations=a.violations + b.violations,
         first_leader_tick=jnp.minimum(a.first_leader_tick, b.first_leader_tick),
@@ -39,7 +40,7 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
 
 @functools.partial(jax.jit, static_argnums=(0, 3))
 def _chunk(cfg: RaftConfig, state: ClusterState, keys: jax.Array, n: int):
-    return scan.run_batch(cfg, state, keys, n)
+    return scan.run_batch_minor(cfg, state, keys, n)
 
 
 def run_chunked(
@@ -61,8 +62,8 @@ def run_chunked(
     done = 0
     while done < n_ticks:
         n = min(chunk, n_ticks - done)
-        state, m, _ = _chunk(cfg, state, keys, n)
-        metrics = jax.vmap(merge_metrics)(metrics, m)
+        state, m = _chunk(cfg, state, keys, n)
+        metrics = merge_metrics(metrics, m)
         done += n
         if callback is not None and callback(done, state, metrics):
             break

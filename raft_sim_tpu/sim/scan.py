@@ -128,11 +128,42 @@ def run_batch(
     return jax.vmap(lambda s, k: run(cfg, s, k, n_ticks, trace=trace))(state, keys)
 
 
+def run_batch_minor(
+    cfg: RaftConfig,
+    state: ClusterState,
+    keys: jax.Array,
+    n_ticks: int,
+):
+    """Batch-minor hot path: same trajectories as `run_batch` (bit-for-bit; see
+    tests/test_batched_parity.py) via models/raft_batched.step_b, with the batch axis
+    transposed to minor once at entry/exit so every per-tick array is TPU-tiled with
+    the batch on the 128-lane dimension. State in/out keeps the public [B, ...]-leading
+    convention. No per-tick trace output (use run_batch for tracing)."""
+    from raft_sim_tpu.models import raft_batched
+
+    batch = state.role.shape[0]
+    s_t = raft_batched.to_batch_minor(state)
+
+    def body(carry, _):
+        s, m = carry
+        inp = jax.vmap(lambda k, now: faults.make_inputs(cfg, k, now))(keys, s.now)
+        inp_t = raft_batched.to_batch_minor(inp)
+        s2, info = raft_batched.step_b(cfg, s, inp_t)
+        m2 = _accumulate(m, info, s.now)  # all fields [B]: elementwise
+        return (s2, m2), None
+
+    (final_t, metrics), _ = lax.scan(
+        body, (s_t, init_metrics_batch(batch)), None, length=n_ticks
+    )
+    return raft_batched.from_batch_minor(final_t), metrics
+
+
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def simulate(cfg: RaftConfig, seed, batch: int, n_ticks: int):
     """One-call batched simulation from a seed: init + scan, fully on device.
 
-    Returns (final_state, RunMetrics) with leading batch axis.
+    Returns (final_state, RunMetrics) with leading batch axis. Uses the batch-minor
+    hot path (same trajectories as run_batch, bit-for-bit).
     """
     root = jax.random.key(seed)
     k_init, k_run = jax.random.split(root)
@@ -140,8 +171,7 @@ def simulate(cfg: RaftConfig, seed, batch: int, n_ticks: int):
 
     state = init_batch(cfg, k_init, batch)
     keys = jax.random.split(k_run, batch)
-    final, metrics, _ = run_batch(cfg, state, keys, n_ticks)
-    return final, metrics
+    return run_batch_minor(cfg, state, keys, n_ticks)
 
 
 def stable_leader_ticks(metrics: RunMetrics) -> jax.Array:

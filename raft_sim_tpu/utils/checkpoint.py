@@ -29,14 +29,22 @@ from raft_sim_tpu.utils.config import RaftConfig
 _FORMAT_VERSION = 1
 
 
+def _normalize(path: str) -> str:
+    """np.savez appends '.npz' to bare paths; normalize so save and load agree."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save(
     path: str,
     cfg: RaftConfig,
     state: ClusterState,
     keys: jax.Array,
     metrics: RunMetrics,
-) -> None:
-    """Write (config, batched state, per-cluster run keys, accumulated metrics)."""
+    seed: int = 0,
+) -> str:
+    """Write (config, batched state, per-cluster run keys, accumulated metrics, seed).
+    Returns the actual path written (always .npz-suffixed)."""
+    path = _normalize(path)
     arrays = {f"state_{f}": np.asarray(v) for f, v in zip(state._fields, state) if f != "mailbox"}
     arrays |= {f"mb_{f}": np.asarray(v) for f, v in zip(state.mailbox._fields, state.mailbox)}
     arrays |= {f"metrics_{f}": np.asarray(v) for f, v in zip(metrics._fields, metrics)}
@@ -44,14 +52,16 @@ def save(
     np.savez_compressed(
         path,
         __version__=np.int32(_FORMAT_VERSION),
+        seed=np.int64(seed),
         config_json=np.bytes_(json.dumps(dataclasses.asdict(cfg)).encode()),
         **arrays,
     )
+    return path
 
 
-def load(path: str) -> tuple[RaftConfig, ClusterState, jax.Array, RunMetrics]:
-    """Read a checkpoint; returns (cfg, state, keys, metrics) ready to resume."""
-    with np.load(path) as z:
+def load(path: str) -> tuple[RaftConfig, ClusterState, jax.Array, RunMetrics, int]:
+    """Read a checkpoint; returns (cfg, state, keys, metrics, seed) ready to resume."""
+    with np.load(_normalize(path)) as z:
         version = int(z["__version__"])
         if version != _FORMAT_VERSION:
             raise ValueError(f"checkpoint format {version}, expected {_FORMAT_VERSION}")
@@ -67,4 +77,5 @@ def load(path: str) -> tuple[RaftConfig, ClusterState, jax.Array, RunMetrics]:
         metrics = RunMetrics(
             **{f: jax.numpy.asarray(z[f"metrics_{f}"]) for f in RunMetrics._fields}
         )
-    return cfg, state, keys, metrics
+        seed = int(z["seed"])
+    return cfg, state, keys, metrics, seed

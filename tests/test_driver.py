@@ -67,11 +67,13 @@ def test_checkpoint_roundtrip(tmp_path):
     save/load at the boundary == run 200 straight."""
     s = Session(CFG, batch=4, seed=5)
     s.run(100, chunk=50)
-    p = str(tmp_path / "ckpt.npz")
-    s.save(p)
+    # Bare path (no .npz): save normalizes and returns the real path; load accepts both.
+    p = s.save(str(tmp_path / "ckpt"))
+    assert p.endswith(".npz")
 
-    s2 = Session.restore(p)
+    s2 = Session.restore(str(tmp_path / "ckpt"))
     assert s2.cfg == CFG
+    assert s2.seed == 5  # seed travels with the checkpoint
     s2.run(100, chunk=50)
 
     ref = Session(CFG, batch=4, seed=5)
@@ -119,10 +121,10 @@ def test_build_config_preset_with_overrides():
         if not hasattr(a, f.name):
             setattr(a, f.name, None)
     a.n_nodes = 9
-    cfg = build_config(a)
+    cfg, batch = build_config(a)
     assert cfg.n_nodes == 9  # override applied
     assert cfg.drop_prob == 0.3  # preset preserved
-    assert a.batch == 100_000  # preset batch filled in
+    assert batch == 100_000  # preset batch filled in
 
 
 def test_cli_run_and_presets(capsys):
