@@ -155,7 +155,9 @@ def main(argv=None) -> int:
     run_p.add_argument("--preset", choices=sorted(PRESETS), default=None)
     run_p.add_argument("--batch", type=int, default=None)
     run_p.add_argument("--ticks", type=int, default=1000)
-    run_p.add_argument("--seed", type=int, default=0)
+    run_p.add_argument("--seed", type=int, default=None,
+                       help="PRNG seed (default 0; stored in checkpoints, so "
+                            "exclusive with --resume)")
     run_p.add_argument("--chunk", type=int, default=4096)
     run_p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto")
     run_p.add_argument("--progress", action="store_true")
@@ -189,12 +191,14 @@ def main(argv=None) -> int:
             conflicting.append("preset")
         if args.batch is not None:
             conflicting.append("batch")
+        if args.seed is not None:
+            conflicting.append("seed")  # the checkpoint carries its own seed
         if conflicting:
             ap.error(f"--resume is exclusive with config flags: {', '.join(conflicting)}")
         sess = Session.restore(args.resume)
     else:
         cfg, batch = build_config(args)
-        sess = Session(cfg, batch=batch, seed=args.seed)
+        sess = Session(cfg, batch=batch, seed=args.seed if args.seed is not None else 0)
 
     if args.trace_ticks or args.trace_events:
         if args.save:

@@ -26,7 +26,8 @@ from raft_sim_tpu.sim.scan import RunMetrics
 from raft_sim_tpu.types import ClusterState, Mailbox
 from raft_sim_tpu.utils.config import RaftConfig
 
-_FORMAT_VERSION = 1
+# v2: added the session seed to the archive.
+_FORMAT_VERSION = 2
 
 
 def _normalize(path: str) -> str:

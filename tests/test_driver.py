@@ -88,8 +88,8 @@ def test_checkpoint_rejects_bad_version(tmp_path):
     import numpy as np_
 
     p = str(tmp_path / "bad.npz")
-    np_.savez(p, __version__=np_.int32(999), config_json=np_.bytes_(b"{}"))
-    with pytest.raises(ValueError, match="format 999"):
+    np_.savez(p, __version__=np_.int32(1), config_json=np_.bytes_(b"{}"))
+    with pytest.raises(ValueError, match="format 1"):
         checkpoint.load(p)
 
 
