@@ -55,9 +55,13 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     b = s.role.shape[-1]
     mb = s.mailbox
-    ids = jnp.arange(n, dtype=jnp.int32)
-    eye3 = jnp.eye(n, dtype=bool)[:, :, None]  # [N, N, 1]
-    src_ids = jnp.broadcast_to(ids[None, :, None], (n, n, 1))  # [dst, src, 1] -> src id
+    # All iota-style constants are built at their final rank (log_ops.iota): Mosaic
+    # cannot lower unit-dim-appending reshapes, and this module doubles as the
+    # pallas_engine kernel body.
+    iota = log_ops.iota
+    ids2 = iota((n, 1), 0)  # [N, 1] node id column
+    eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)  # [N, N, 1]
+    src_ids = iota((n, n, 1), 1)  # [dst, src, 1] -> src id
 
     # ---- phase 0: delivery -------------------------------------------------------
     deliver = inp.deliver_mask & ~eye3  # [N, N, B]
@@ -87,10 +91,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     )
     can_grant = cur_rv & up_to_date
     lowest = jnp.min(jnp.where(can_grant, src_ids, n), axis=1)  # [N, B]
-    grant = jnp.where(
-        (voted_for != NIL)[:, None, :],
-        can_grant & (src_ids == voted_for[:, None, :]),
-        can_grant & (src_ids == lowest[:, None, :]),
+    # Boolean arithmetic instead of where-on-bools: Mosaic cannot lower vector
+    # selects with i1 operands.
+    has_vote = (voted_for != NIL)[:, None, :]
+    grant = (has_vote & can_grant & (src_ids == voted_for[:, None, :])) | (
+        ~has_vote & can_grant & (src_ids == lowest[:, None, :])
     )
     granted_any = jnp.any(grant, axis=1)  # [N, B]
     voted_for = jnp.where((voted_for == NIL) & granted_any, lowest, voted_for)
@@ -122,9 +127,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     consistent = (prev_i == 0) | ((prev_i <= s.log_len) & (prev_stored_term == prev_t))
     ae_ok = has_ae & consistent
 
-    ks = jnp.arange(e, dtype=jnp.int32)
-    gidx0 = prev_i[:, None, :] + ks[None, :, None]  # [N, E, B] 0-based slots
-    in_ent = ks[None, :, None] < n_ent[:, None, :]
+    ks_e = iota((1, e, 1), 1)  # [1, E, 1]
+    gidx0 = prev_i[:, None, :] + ks_e  # [N, E, B] 0-based slots
+    in_ent = ks_e < n_ent[:, None, :]
     exists = gidx0 < s.log_len[:, None, :]
     stored = log_ops.window_b(s.log_term, prev_i, e)  # [N, E, B]
     mismatch = in_ent & exists & (stored != ent_term_in)
@@ -159,7 +164,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     n_votes = jnp.sum(votes, axis=1).astype(jnp.int32)  # [N, B]
     win = (role == CANDIDATE) & (n_votes >= cfg.quorum)
     role = jnp.where(win, LEADER, role)
-    leader_id = jnp.where(win, ids[:, None], leader_id)
+    leader_id = jnp.where(win, ids2, leader_id)
     next_index = jnp.where(win[:, None, :], (log_len + 1)[:, None, :], s.next_index)
     match_index = jnp.where(win[:, None, :], 0, s.match_index)
 
@@ -196,8 +201,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     # ---- phase 6: client command injection ----------------------------------------
     do_inject = (inp.client_cmd[None, :] != NIL) & is_leader & (log_len < cap)
     inj_pos = jnp.where(do_inject, log_len, cap)  # [N, B]; cap matches no slot
-    cs = jnp.arange(cap, dtype=jnp.int32)
-    inj_oh = cs[None, :, None] == inj_pos[:, None, :]  # [N, CAP, B]
+    inj_oh = iota((1, cap, 1), 1) == inj_pos[:, None, :]  # [N, CAP, B]
     log_term_arr = jnp.where(inj_oh, term[:, None, :], log_term_arr)
     log_val_arr = jnp.where(inj_oh, inp.client_cmd[None, None, :], log_val_arr)
     log_len = log_len + do_inject
@@ -215,9 +219,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     start_election = expired & ~is_leader
     term = term + start_election
     role = jnp.where(start_election, CANDIDATE, role)
-    voted_for = jnp.where(start_election, ids[:, None], voted_for)
+    voted_for = jnp.where(start_election, ids2, voted_for)
     leader_id = jnp.where(start_election, NIL, leader_id)
-    votes = jnp.where(start_election[:, None, :], eye3, votes)
+    se = start_election[:, None, :]
+    votes = (se & eye3) | (~se & votes)  # where-on-bools; see `grant` above
     deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
 
     # ---- phase 8: outbox ---------------------------------------------------------
@@ -235,7 +240,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_req_prev_term = jnp.where(rv_edge, new_last_term[:, None, :], out_prev_term_ae)
     out_req_commit = jnp.broadcast_to(commit[:, None, :], (n, n, b))
     out_req_n_ent = jnp.where(ae_edge, n_out, 0)
-    ent_used = ks[None, None, :, None] < n_out[:, :, None, :]  # [src, dst, E, B]
+    ent_used = iota((1, 1, e, 1), 2) < n_out[:, :, None, :]  # [src, dst, E, B]
     out_ent_term = jnp.where(ent_used, log_ops.window_b(log_term_arr, prev_out, e), 0)
     out_ent_val = jnp.where(ent_used, log_ops.window_b(log_val_arr, prev_out, e), 0)
 
@@ -294,7 +299,8 @@ def _step_info_b(
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
     b = new.role.shape[-1]
-    eye3 = jnp.eye(n, dtype=bool)[:, :, None]
+    iota = log_ops.iota
+    eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)
     is_leader = new.role == LEADER
     f = jnp.zeros((b,), bool)
 
@@ -316,15 +322,13 @@ def _step_info_b(
 
     if cfg.check_log_matching:
         minc = jnp.minimum(new.commit_index[:, None, :], new.commit_index[None, :, :])
-        ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
-        both = ks[None, None, :, None] < minc[:, :, None, :]
+        both = iota((1, 1, cfg.log_capacity, 1), 2) < minc[:, :, None, :]
         differ = new.log_term[:, None] != new.log_term[None, :]
         viol_match = jnp.any(both & differ, axis=(0, 1, 2))
     else:
         viol_match = f
 
-    ids = jnp.arange(n, dtype=jnp.int32)
-    leader = jnp.min(jnp.where(is_leader, ids[:, None], n), axis=0)  # [B]
+    leader = jnp.min(jnp.where(is_leader, iota((n, 1), 0), n), axis=0)  # [B]
     return StepInfo(
         viol_election_safety=viol_election,
         viol_commit=viol_commit,

@@ -98,6 +98,17 @@ def write_window(
 # --------------------------------------------------------------------------------------
 
 
+def iota(shape, d):
+    """int32 iota built at its final rank. The single shared helper for all batched
+    kernels: Mosaic (Pallas TPU) cannot lower the unit-dim-appending reshapes that
+    `jnp.arange(...)[None, :, None]` produces, and these ops run inside the
+    pallas_engine kernel."""
+    return jax.lax.broadcastediota(jnp.int32, shape, d)
+
+
+
+
+
 def term_at_b(log_term: jax.Array, index1: jax.Array) -> jax.Array:
     """Batched term_at. log_term: [N, CAP, B]; index1: [N, B] or [N, M, B].
 
@@ -105,12 +116,11 @@ def term_at_b(log_term: jax.Array, index1: jax.Array) -> jax.Array:
     where(index1 > 0, ...) mask in the gather form.
     """
     cap = log_term.shape[1]
-    cs = jnp.arange(cap, dtype=jnp.int32)
     if index1.ndim == 2:  # [N, B] -> [N, B]
-        oh = cs[None, :, None] == (index1 - 1)[:, None, :]  # [N, CAP, B]
+        oh = iota((1, cap, 1), 1) == (index1 - 1)[:, None, :]  # [N, CAP, B]
         return jnp.sum(jnp.where(oh, log_term, 0), axis=1)
     # [N, M, B] -> [N, M, B]
-    oh = cs[None, None, :, None] == (index1 - 1)[:, :, None, :]  # [N, M, CAP, B]
+    oh = iota((1, 1, cap, 1), 2) == (index1 - 1)[:, :, None, :]  # [N, M, CAP, B]
     return jnp.sum(jnp.where(oh, log_term[:, None], 0), axis=2)
 
 
@@ -124,15 +134,13 @@ def window_b(arr: jax.Array, start0: jax.Array, e: int) -> jax.Array:
     [N, M, B] -> [N, M, E, B]. Out-of-range slots clamp to the last slot (callers mask
     with an explicit count), matching the clipped gather form."""
     cap = arr.shape[1]
-    cs = jnp.arange(cap, dtype=jnp.int32)
-    ks = jnp.arange(e, dtype=jnp.int32)
     if start0.ndim == 2:  # [N, B]
-        pos = jnp.clip(start0[:, None, :] + ks[None, :, None], 0, cap - 1)  # [N, E, B]
-        oh = cs[None, None, :, None] == pos[:, :, None, :]  # [N, E, CAP, B]
+        pos = jnp.clip(start0[:, None, :] + iota((1, e, 1), 1), 0, cap - 1)  # [N, E, B]
+        oh = iota((1, 1, cap, 1), 2) == pos[:, :, None, :]  # [N, E, CAP, B]
         return jnp.sum(jnp.where(oh, arr[:, None], 0), axis=2)
     # [N, M, B]
-    pos = jnp.clip(start0[:, :, None, :] + ks[None, None, :, None], 0, cap - 1)
-    oh = cs[None, None, None, :, None] == pos[:, :, :, None, :]  # [N, M, E, CAP, B]
+    pos = jnp.clip(start0[:, :, None, :] + iota((1, 1, e, 1), 2), 0, cap - 1)
+    oh = iota((1, 1, 1, cap, 1), 3) == pos[:, :, :, None, :]  # [N, M, E, CAP, B]
     return jnp.sum(jnp.where(oh, arr[:, None, None], 0), axis=3)
 
 
@@ -148,11 +156,9 @@ def write_window_b(
     most one unmasked entry; masked entries are routed to position `cap`, which matches
     no slot (the scatter form's mode='drop')."""
     cap = arr.shape[1]
-    cs = jnp.arange(cap, dtype=jnp.int32)
-    ks = jnp.arange(vals.shape[1], dtype=jnp.int32)
-    pos = start0[:, None, :] + ks[None, :, None]  # [N, E, B]
+    pos = start0[:, None, :] + iota((1, vals.shape[1], 1), 1)  # [N, E, B]
     pos = jnp.where(mask, pos, cap)
-    oh = cs[None, None, :, None] == pos[:, :, None, :]  # [N, E, CAP, B]
+    oh = iota((1, 1, cap, 1), 2) == pos[:, :, None, :]  # [N, E, CAP, B]
     hit = jnp.any(oh, axis=1)  # [N, CAP, B]
     val = jnp.sum(jnp.where(oh, vals[:, :, None, :], 0), axis=1)
     return jnp.where(hit, val, arr)

@@ -133,14 +133,20 @@ def run_batch_minor(
     state: ClusterState,
     keys: jax.Array,
     n_ticks: int,
+    step_fn=None,
 ):
     """Batch-minor hot path: same trajectories as `run_batch` (bit-for-bit; see
     tests/test_batched_parity.py) via models/raft_batched.step_b, with the batch axis
     transposed to minor once at entry/exit so every per-tick array is TPU-tiled with
     the batch on the 128-lane dimension. State in/out keeps the public [B, ...]-leading
-    convention. No per-tick trace output (use run_batch for tracing)."""
+    convention. No per-tick trace output (use run_batch for tracing).
+
+    `step_fn(cfg, state_minor, inputs_minor)` overrides the tick kernel (the Pallas
+    engine passes its kernelized step here so both engines share one scan body)."""
     from raft_sim_tpu.models import raft_batched
 
+    if step_fn is None:
+        step_fn = raft_batched.step_b
     batch = state.role.shape[0]
     s_t = raft_batched.to_batch_minor(state)
 
@@ -148,7 +154,7 @@ def run_batch_minor(
         s, m = carry
         inp = jax.vmap(lambda k, now: faults.make_inputs(cfg, k, now))(keys, s.now)
         inp_t = raft_batched.to_batch_minor(inp)
-        s2, info = raft_batched.step_b(cfg, s, inp_t)
+        s2, info = step_fn(cfg, s, inp_t)
         m2 = _accumulate(m, info, s.now)  # all fields [B]: elementwise
         return (s2, m2), None
 
