@@ -34,9 +34,27 @@ from raft_sim_tpu.utils.config import PRESETS, RaftConfig
 
 
 def select_backend(backend: str) -> None:
-    """Pick the JAX platform before any computation (north-star `:backend` option)."""
-    if backend != "auto":
-        jax.config.update("jax_platforms", backend)
+    """Pick the JAX platform before any computation (north-star `:backend` option).
+
+    `tpu` is resolved against whatever platform name the hardware actually registers
+    under -- TPU plugins may expose a plugin-specific name (e.g. `axon` for a tunneled
+    chip) that `jax.config.update("jax_platforms", "tpu")` would reject. Any other
+    name (cpu, axon, ...) is passed through to jax_platforms directly.
+    """
+    if backend == "auto":
+        return
+    if backend == "tpu":
+        # Clear any JAX_PLATFORMS=cpu env pin first: under default priority,
+        # registered accelerator plugins outrank cpu, so "tpu" means "the
+        # accelerator, whatever its platform name".
+        jax.config.update("jax_platforms", "")
+        plats = {d.platform for d in jax.devices()}  # initializes backends
+        if not plats - {"cpu"}:
+            raise RuntimeError(
+                f"--backend tpu: no accelerator platform registered (found {sorted(plats)})"
+            )
+        return
+    jax.config.update("jax_platforms", backend)
 
 
 class Session:
@@ -128,7 +146,7 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
             p.add_argument(flag, type=lambda s: s.lower() in ("1", "true", "yes"),
                            default=None, metavar="BOOL")
         else:
-            p.add_argument(flag, type=_FLAG_TYPES[f.type], default=None)
+            p.add_argument(flag, type=_FLAG_TYPES.get(f.type, str), default=None)
 
 
 def build_config(args) -> tuple[RaftConfig, int]:
@@ -159,7 +177,13 @@ def main(argv=None) -> int:
                        help="PRNG seed (default 0; stored in checkpoints, so "
                             "exclusive with --resume)")
     run_p.add_argument("--chunk", type=int, default=4096)
-    run_p.add_argument("--backend", choices=["auto", "cpu", "tpu"], default="auto")
+    run_p.add_argument("--backend", default="auto", metavar="NAME",
+                       help="auto | cpu | tpu | any registered jax platform name "
+                            "(e.g. 'axon'); 'tpu' resolves to the machine's "
+                            "accelerator whatever it registers as")
+    run_p.add_argument("--profile", metavar="DIR", default=None,
+                       help="capture a jax.profiler trace of the run into DIR "
+                            "(view with tensorboard/xprof)")
     run_p.add_argument("--progress", action="store_true")
     run_p.add_argument("--trace-ticks", type=int, default=0,
                        help="print per-tick info lines for one cluster")
@@ -201,9 +225,9 @@ def main(argv=None) -> int:
         sess = Session(cfg, batch=batch, seed=args.seed if args.seed is not None else 0)
 
     if args.trace_ticks or args.trace_events:
-        if args.save:
-            ap.error("--save has no effect with --trace-ticks/--trace-events "
-                     "(tracing does not advance the session)")
+        if args.save or args.profile:
+            ap.error("--save/--profile have no effect with --trace-ticks/"
+                     "--trace-events (tracing does not advance the session)")
         n = args.trace_ticks or args.ticks
         infos, states = sess.trace(n, cluster=args.trace_cluster)
         if args.trace_events:
@@ -214,9 +238,17 @@ def main(argv=None) -> int:
                 print(line)
         return 0
 
+    import contextlib
+
+    prof = (
+        jax.profiler.trace(args.profile, create_perfetto_trace=True)
+        if args.profile
+        else contextlib.nullcontext()
+    )
     t0 = time.perf_counter()
-    sess.run(args.ticks, chunk=args.chunk, progress=args.progress)
-    jax.block_until_ready(sess.state)
+    with prof:
+        sess.run(args.ticks, chunk=args.chunk, progress=args.progress)
+        jax.block_until_ready(sess.state)
     dt = time.perf_counter() - t0
 
     out = sess.summary()

@@ -103,7 +103,7 @@ def iota(shape, d):
     kernels: Mosaic (Pallas TPU) cannot lower the unit-dim-appending reshapes that
     `jnp.arange(...)[None, :, None]` produces, and these ops run inside the
     pallas_engine kernel."""
-    return jax.lax.broadcastediota(jnp.int32, shape, d)
+    return jax.lax.broadcasted_iota(jnp.int32, shape, d)
 
 
 
@@ -113,7 +113,9 @@ def term_at_b(log_term: jax.Array, index1: jax.Array) -> jax.Array:
     """Batched term_at. log_term: [N, CAP, B]; index1: [N, B] or [N, M, B].
 
     index1 == 0 matches no slot and yields 0 (the "no entry" sentinel), like the
-    where(index1 > 0, ...) mask in the gather form.
+    where(index1 > 0, ...) mask in the gather form. Precondition (both variants):
+    index1 <= cap — callers clip to log_len <= cap. Above cap this form yields 0
+    while the gather form clamps to the last slot; do not rely on either.
     """
     cap = log_term.shape[1]
     if index1.ndim == 2:  # [N, B] -> [N, B]

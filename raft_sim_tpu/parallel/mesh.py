@@ -94,7 +94,7 @@ class FleetSummary(NamedTuple):
     n_clusters: int
     total_violations: int
     n_stable: int  # clusters that ended with a continuously-held leader
-    p50_stable_tick: float  # median ticks-to-stable-leader
+    p50_stable_tick: float | None  # median ticks-to-stable-leader; None if no cluster stabilized
     max_term: int
     total_msgs: int
 
@@ -106,7 +106,8 @@ def summarize(metrics) -> FleetSummary:
     import numpy as np
 
     reached = stable[stable < scan.NEVER]
-    p50 = float(np.median(reached)) if reached.size else float("inf")
+    # None (JSON null) rather than inf: json.dumps(inf) emits non-standard `Infinity`.
+    p50 = float(np.median(reached)) if reached.size else None
     m = jax.device_get(metrics)
     return FleetSummary(
         n_clusters=int(m.ticks.shape[0]),
