@@ -32,6 +32,11 @@ SURVEY.md section 2.3 and deliberately NOT carried):
   phase 8  outbox                <- request-vote-rpc / append-entries-rpc
                                     (core.clj:48-67) writing the next tick's mailbox
   phase 9  invariants + metrics  <- absent in the reference; north-star requirement
+  phase -1 restart wipe          <- the reference's process-death model (only committed
+                                    values are durable, log.clj:16-18); here restart is
+                                    spec-correct (persistent term/vote/log survive,
+                                    volatile state wiped), and down nodes are gated out
+                                    of delivery, timers, leadership, and commit
 
 Everything is written for ONE cluster (shapes [N], [N, N], [N, CAP]); `jax.vmap` lifts
 to [batch, ...] and `lax.scan` (sim/scan.py) rolls ticks.
@@ -63,15 +68,38 @@ from raft_sim_tpu.utils.config import RaftConfig
 def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
     """Advance one cluster by one tick. Pure; jit/vmap/scan-safe."""
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
-    mb = s.mailbox
     ids = jnp.arange(n, dtype=jnp.int32)
     eye = jnp.eye(n, dtype=bool)
     src_ids = jnp.broadcast_to(ids[None, :], (n, n))  # [dst, src] -> src id
 
+    # ---- phase -1: restart (crash fault) -----------------------------------------
+    # A node restarting this tick rejoins as a fresh follower: the Raft persistent
+    # triple (currentTerm, votedFor, log[]) survives, everything else is volatile and
+    # wiped (Raft fig. 2 state table). The reference instead persists only committed
+    # values (log.clj:16-18), so its restarted process forgets term/vote -- bug
+    # 2.3.12, deliberately not carried. Wiping commitIndex here (before `old` is
+    # captured for phase 9) keeps the monotonic-commit invariant meaningful.
+    rs = inp.restarted
+    s = s._replace(
+        role=jnp.where(rs, FOLLOWER, s.role),
+        leader_id=jnp.where(rs, NIL, s.leader_id),
+        votes=s.votes & ~rs[:, None],
+        next_index=jnp.where(rs[:, None], 1, s.next_index),
+        match_index=jnp.where(rs[:, None], 0, s.match_index),
+        commit_index=jnp.where(rs, 0, s.commit_index),
+        deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
+    )
+    mb = s.mailbox
+
     # ---- phase 0: delivery -------------------------------------------------------
     # The fault mask is the TPU-native form of the reference's silently-dropped HTTP
-    # call (client.clj:38-40): a zeroed entry in the delivery mask.
-    deliver = inp.deliver_mask & ~eye
+    # call (client.clj:38-40): a zeroed entry in the delivery mask. A down node is
+    # silent in both directions: it receives nothing, and anything it had in flight
+    # dies with it (the crashed process's sockets). Mailbox slots hold messages sent
+    # last tick, so a node that just restarted must also not see them -- they were
+    # addressed to a dead process (alive now & alive at send time = alive & ~restarted).
+    dst_up = inp.alive & ~inp.restarted
+    deliver = inp.deliver_mask & ~eye & dst_up[:, None] & inp.alive[None, :]
     req_in = deliver & (mb.req_type != 0)  # [dst, src]
     resp_in = deliver & (mb.resp_type != 0)
 
@@ -187,7 +215,8 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     )
     votes = votes | new_votes
     n_votes = jnp.sum(votes, axis=1).astype(jnp.int32)
-    win = (role == CANDIDATE) & (n_votes >= cfg.quorum)
+    # A down candidate cannot assume leadership from votes banked before it crashed.
+    win = (role == CANDIDATE) & (n_votes >= cfg.quorum) & inp.alive
     role = jnp.where(win, LEADER, role)
     leader_id = jnp.where(win, ids, leader_id)
     # Fresh leader bookkeeping (leader-state core.clj:40-42): nextIndex = last log
@@ -220,7 +249,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # Spec 5.4.2: only commit entries from the current term by counting replicas.
     quorum_term = log_ops.term_at(log_term_arr, quorum_match)
     commit = jnp.where(
-        is_leader & (quorum_match > commit) & (quorum_term == term),
+        is_leader & inp.alive & (quorum_match > commit) & (quorum_term == term),
         quorum_match,
         commit,
     )
@@ -229,7 +258,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # The simulator's "client" writes straight to the leader; the reference's
     # redirect-to-leader dance (core.clj:152-155) has no array equivalent because
     # cluster membership is globally visible here.
-    do_inject = (inp.client_cmd != NIL) & is_leader & (log_len < cap)
+    do_inject = (inp.client_cmd != NIL) & is_leader & inp.alive & (log_len < cap)
     inj_pos = jnp.where(do_inject, log_len, cap)  # cap = out of bounds -> dropped
     log_term_arr = log_term_arr.at[ids, inj_pos].set(term, mode="drop")
     log_val_arr = log_val_arr.at[ids, inj_pos].set(
@@ -244,7 +273,8 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     reset_election = granted_any | has_ae | saw_higher
     deadline = jnp.where(reset_election, clock + inp.timeout_draw, s.deadline)
     deadline = jnp.where(win, clock + cfg.heartbeat_ticks, deadline)
-    expired = clock >= deadline
+    # A down node's timers cannot fire; its fresh deadline is set by the restart wipe.
+    expired = (clock >= deadline) & inp.alive
 
     # Leader heartbeat (heartbeat-handler core.clj:162-164).
     heartbeat = expired & is_leader
@@ -326,7 +356,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         mailbox=new_mb,
     )
 
-    info = _step_info(cfg, s, new_state, req_in, resp_in)
+    info = _step_info(cfg, s, new_state, req_in, resp_in, inp.alive)
     return new_state, info
 
 
@@ -336,11 +366,18 @@ def _step_info(
     new: ClusterState,
     req_in: jax.Array,
     resp_in: jax.Array,
+    alive: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
     n = cfg.n_nodes
     eye = jnp.eye(n, dtype=bool)
     is_leader = new.role == LEADER
+    # Observability counts only *live* leaders: a crashed node frozen in LEADER role
+    # provides no leadership (the cluster is leaderless until re-election), and the
+    # north-star ticks-to-stable-leader metric must reflect that. The safety checks
+    # below keep the unmasked roles: a frozen stale leader still participates in the
+    # at-most-one-leader-per-term invariant.
+    live_leader = is_leader & alive
     f = jnp.bool_(False)
 
     if cfg.check_invariants:
@@ -371,13 +408,13 @@ def _step_info(
     else:
         viol_match = f
 
-    leader = jnp.min(jnp.where(is_leader, jnp.arange(n, dtype=jnp.int32), n))
+    leader = jnp.min(jnp.where(live_leader, jnp.arange(n, dtype=jnp.int32), n))
     return StepInfo(
         viol_election_safety=viol_election,
         viol_commit=viol_commit,
         viol_log_matching=viol_match,
         leader=jnp.where(leader < n, leader, NIL).astype(jnp.int32),
-        n_leaders=jnp.sum(is_leader).astype(jnp.int32),
+        n_leaders=jnp.sum(live_leader).astype(jnp.int32),
         max_term=jnp.max(new.term),
         max_commit=jnp.max(new.commit_index),
         min_commit=jnp.min(new.commit_index),

@@ -54,7 +54,6 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     """
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     b = s.role.shape[-1]
-    mb = s.mailbox
     # All iota-style constants are built at their final rank (log_ops.iota): Mosaic
     # cannot lower unit-dim-appending reshapes, and this module doubles as the
     # pallas_engine kernel body.
@@ -63,8 +62,25 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)  # [N, N, 1]
     src_ids = iota((n, n, 1), 1)  # [dst, src, 1] -> src id
 
+    # ---- phase -1: restart (crash fault) -----------------------------------------
+    rs = inp.restarted  # [N, B]
+    rs2 = rs[:, None, :]
+    s = s._replace(
+        role=jnp.where(rs, FOLLOWER, s.role),
+        leader_id=jnp.where(rs, NIL, s.leader_id),
+        votes=s.votes & ~rs2,
+        next_index=jnp.where(rs2, 1, s.next_index),
+        match_index=jnp.where(rs2, 0, s.match_index),
+        commit_index=jnp.where(rs, 0, s.commit_index),
+        deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
+    )
+    mb = s.mailbox
+
     # ---- phase 0: delivery -------------------------------------------------------
-    deliver = inp.deliver_mask & ~eye3  # [N, N, B]
+    dst_up = inp.alive & ~inp.restarted  # alive now AND at send time (last tick)
+    deliver = (
+        inp.deliver_mask & ~eye3 & dst_up[:, None, :] & inp.alive[None, :, :]
+    )  # [N, N, B]
     req_in = deliver & (mb.req_type != 0)
     resp_in = deliver & (mb.resp_type != 0)
 
@@ -162,7 +178,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     )
     votes = votes | new_votes
     n_votes = jnp.sum(votes, axis=1).astype(jnp.int32)  # [N, B]
-    win = (role == CANDIDATE) & (n_votes >= cfg.quorum)
+    win = (role == CANDIDATE) & (n_votes >= cfg.quorum) & inp.alive
     role = jnp.where(win, LEADER, role)
     leader_id = jnp.where(win, ids2, leader_id)
     next_index = jnp.where(win[:, None, :], (log_len + 1)[:, None, :], s.next_index)
@@ -193,13 +209,13 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     quorum_match = jnp.max(jnp.where(qualifies, match_with_self, 0), axis=1)  # [N, B]
     quorum_term = log_ops.term_at_b(log_term_arr, quorum_match)
     commit = jnp.where(
-        is_leader & (quorum_match > commit) & (quorum_term == term),
+        is_leader & inp.alive & (quorum_match > commit) & (quorum_term == term),
         quorum_match,
         commit,
     )
 
     # ---- phase 6: client command injection ----------------------------------------
-    do_inject = (inp.client_cmd[None, :] != NIL) & is_leader & (log_len < cap)
+    do_inject = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive & (log_len < cap)
     inj_pos = jnp.where(do_inject, log_len, cap)  # [N, B]; cap matches no slot
     inj_oh = iota((1, cap, 1), 1) == inj_pos[:, None, :]  # [N, CAP, B]
     log_term_arr = jnp.where(inj_oh, term[:, None, :], log_term_arr)
@@ -211,7 +227,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     reset_election = granted_any | has_ae | saw_higher
     deadline = jnp.where(reset_election, clock + inp.timeout_draw, s.deadline)
     deadline = jnp.where(win, clock + cfg.heartbeat_ticks, deadline)
-    expired = clock >= deadline
+    expired = (clock >= deadline) & inp.alive
 
     heartbeat = expired & is_leader
     deadline = jnp.where(heartbeat, clock + cfg.heartbeat_ticks, deadline)
@@ -285,7 +301,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         mailbox=new_mb,
     )
 
-    info = _step_info_b(cfg, s, new_state, req_in, resp_in)
+    info = _step_info_b(cfg, s, new_state, req_in, resp_in, inp.alive)
     return new_state, info
 
 
@@ -295,6 +311,7 @@ def _step_info_b(
     new: ClusterState,
     req_in: jax.Array,
     resp_in: jax.Array,
+    alive: jax.Array,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
@@ -302,6 +319,7 @@ def _step_info_b(
     iota = log_ops.iota
     eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)
     is_leader = new.role == LEADER
+    live_leader = is_leader & alive  # see raft._step_info: leadership metrics are live-only
     f = jnp.zeros((b,), bool)
 
     if cfg.check_invariants:
@@ -328,13 +346,13 @@ def _step_info_b(
     else:
         viol_match = f
 
-    leader = jnp.min(jnp.where(is_leader, iota((n, 1), 0), n), axis=0)  # [B]
+    leader = jnp.min(jnp.where(live_leader, iota((n, 1), 0), n), axis=0)  # [B]
     return StepInfo(
         viol_election_safety=viol_election,
         viol_commit=viol_commit,
         viol_log_matching=viol_match,
         leader=jnp.where(leader < n, leader, NIL).astype(jnp.int32),
-        n_leaders=jnp.sum(is_leader, axis=0).astype(jnp.int32),
+        n_leaders=jnp.sum(live_leader, axis=0).astype(jnp.int32),
         max_term=jnp.max(new.term, axis=0),
         max_commit=jnp.max(new.commit_index, axis=0),
         min_commit=jnp.min(new.commit_index, axis=0),

@@ -12,6 +12,10 @@ inputs derived deterministically from (cluster key, tick):
     probability) split into two random halves whose cross edges deliver nothing
     (BASELINE config 5),
   - clock skew: a node's local clock occasionally stalls (+0) or jumps (+2),
+  - node crash/restart: a windowed renewal schedule (alive_at) downs nodes for
+    bounded spans; restart wipes spec-volatile state but keeps the Raft persistent
+    triple -- unlike the reference, whose restarted process loses term/vote/entries
+    (log.clj:16-18, SURVEY.md 2.3.12),
   - randomized election-timeout draws (the reference's 5000+rand(5000) ms,
     core.clj:174),
   - client command injection on a fixed cadence (the reference's external curl against
@@ -33,6 +37,36 @@ import jax.numpy as jnp
 from raft_sim_tpu.types import NIL, StepInputs
 from raft_sim_tpu.utils.config import RaftConfig
 from raft_sim_tpu.utils.rng import draw_timeouts
+
+
+def crash_key(key: jax.Array) -> jax.Array:
+    """The dedicated crash-schedule stream for a cluster key. fold_in(-1) is disjoint
+    from the per-window fold_in(k_part, window >= 0) draws sharing this base."""
+    _, _, k_part = jax.random.split(key, 3)
+    return jax.random.fold_in(k_part, jnp.int32(-1))
+
+
+def alive_at(cfg: RaftConfig, ckey: jax.Array, now: jax.Array) -> jax.Array:
+    """[N] bool node liveness at tick `now` -- a pure function of the crash stream, so
+    trajectories stay replayable with no RNG or downtime counter in the scan carry.
+
+    Windowed renewal process: node i is down during ticks
+    [w*P + start_i, w*P + start_i + dur_i) of window w (clipped at the window edge,
+    so a node is never down across a window boundary) iff its per-window Bernoulli
+    crash draw fired. `now < 0` reports alive (so tick 0 is never a "restart").
+    """
+    n = cfg.n_nodes
+    if cfg.crash_prob <= 0:
+        return jnp.ones((n,), bool)
+    window = now // cfg.crash_period
+    off = now - window * cfg.crash_period
+    wkey = jax.random.fold_in(ckey, window)
+    k_sel, k_start, k_dur = jax.random.split(wkey, 3)
+    crashed = jax.random.bernoulli(k_sel, cfg.crash_prob, (n,))
+    start = jax.random.randint(k_start, (n,), 0, cfg.crash_period)
+    dur = jax.random.randint(k_dur, (n,), 1, cfg.crash_down_ticks + 1)
+    down = crashed & (off >= start) & (off < start + dur) & (now >= 0)
+    return ~down
 
 
 def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
@@ -85,9 +119,20 @@ def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
         client_cmd = jnp.int32(NIL)
     client_cmd = jnp.asarray(client_cmd, jnp.int32)
 
+    # Crash/restart schedule (restart edge = alive now, down last tick).
+    if cfg.crash_prob > 0:
+        ckey = crash_key(key)
+        alive = alive_at(cfg, ckey, now)
+        restarted = alive & ~alive_at(cfg, ckey, now - 1)
+    else:
+        alive = jnp.ones((n,), bool)
+        restarted = jnp.zeros((n,), bool)
+
     return StepInputs(
         deliver_mask=deliver,
         skew=skew,
         timeout_draw=timeout_draw,
         client_cmd=client_cmd,
+        alive=alive,
+        restarted=restarted,
     )

@@ -110,6 +110,8 @@ class StepInputs(NamedTuple):
     skew: jax.Array  # [N] int32 local-clock increment this tick (normally 1)
     timeout_draw: jax.Array  # [N] int32 election timeout to use on any timer reset
     client_cmd: jax.Array  # scalar int32 command value offered to the leader; NIL = none
+    alive: jax.Array  # [N] bool; False = node crashed this tick (silent, frozen)
+    restarted: jax.Array  # [N] bool; True = node came back up this tick (volatile wipe)
 
 
 class StepInfo(NamedTuple):

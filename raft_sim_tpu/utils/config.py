@@ -49,6 +49,18 @@ class RaftConfig:
     # Clock skew: each tick, a node's local clock advances by 0 or 2 instead of 1 with
     # this probability (split evenly between stall and jump).
     clock_skew_prob: float = 0.0
+    # Node crash/restart: the reference's real-world failure mode is a killed process
+    # restarting with amnesia -- only committed values hit disk (log.clj:16-18), so
+    # term/vote/entries are lost (bug 2.3.12). Here restart is spec-correct: the Raft
+    # persistent triple (currentTerm, votedFor, log[]) survives; everything else
+    # (role, leaderId, votes, next/matchIndex, commitIndex, timers) is volatile and
+    # wiped. The schedule is a pure function of (cluster key, tick): time is split
+    # into windows of `crash_period` ticks; in each window each node independently
+    # crashes with prob `crash_prob`, staying down for a uniform 1..`crash_down_ticks`
+    # span at a random offset (clipped at the window edge).
+    crash_prob: float = 0.0
+    crash_period: int = 64
+    crash_down_ticks: int = 12
 
     # Client command injection (reference: external curl POST /client-set,
     # server.clj:8-12, core.clj:151-160). Every `client_interval` ticks one command is
@@ -66,6 +78,9 @@ class RaftConfig:
         assert self.heartbeat_ticks >= 1
         assert self.election_min_ticks > self.heartbeat_ticks
         assert self.election_range_ticks >= 1
+        if self.crash_prob > 0:
+            assert self.crash_period >= 2
+            assert 1 <= self.crash_down_ticks <= self.crash_period
 
     @property
     def quorum(self) -> int:

@@ -60,10 +60,27 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     log_term = s["log_term"].copy()
     log_val = s["log_val"].copy()
     log_len = s["log_len"].copy()
+    deadline = s["deadline"].copy()
+
+    alive = np.asarray(inp["alive"], bool)
+    restarted = np.asarray(inp["restarted"], bool)
+
+    # ---- phase -1: restart wipe (persistent term/vote/log survive; volatile wiped)
+    for d in range(n):
+        if restarted[d]:
+            role[d] = FOLLOWER
+            leader_id[d] = NIL
+            votes[d, :] = False
+            next_index[d, :] = 1
+            match_index[d, :] = 0
+            commit[d] = 0
+            deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
 
     # ---- phase 0: delivery
     deliver = np.asarray(inp["deliver_mask"], bool).copy()
     np.fill_diagonal(deliver, False)
+    # dst must be alive now AND at send time (last tick): alive & ~restarted.
+    deliver &= (alive & ~restarted)[:, None] & alive[None, :]
     req_in = deliver & (mb["req_type"] != 0)
     resp_in = deliver & (mb["resp_type"] != 0)
 
@@ -187,7 +204,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 votes[d, src] = True
     win = np.zeros(n, bool)
     for d in range(n):
-        if role[d] == CANDIDATE and int(votes[d].sum()) >= cfg.quorum:
+        if role[d] == CANDIDATE and int(votes[d].sum()) >= cfg.quorum and alive[d]:
             win[d] = True
             role[d] = LEADER
             leader_id[d] = d
@@ -212,7 +229,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
 
     # ---- phase 5: leader commit advancement
     for d in range(n):
-        if role[d] != LEADER:
+        if role[d] != LEADER or not alive[d]:
             continue
         match = match_index[d].copy()
         match[d] = log_len[d]
@@ -223,14 +240,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # ---- phase 6: client injection
     cmd = int(inp["client_cmd"])
     for d in range(n):
-        if cmd != NIL and role[d] == LEADER and log_len[d] < cap:
+        if cmd != NIL and role[d] == LEADER and alive[d] and log_len[d] < cap:
             log_term[d, log_len[d]] = term[d]
             log_val[d, log_len[d]] = cmd
             log_len[d] += 1
 
     # ---- phase 7: timers
     clock = s["clock"] + np.asarray(inp["skew"], np.int32)
-    deadline = s["deadline"].copy()
     heartbeat = np.zeros(n, bool)
     start_election = np.zeros(n, bool)
     for d in range(n):
@@ -238,7 +254,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
         if win[d]:
             deadline[d] = clock[d] + cfg.heartbeat_ticks
-        expired = clock[d] >= deadline[d]
+        expired = clock[d] >= deadline[d] and alive[d]
         if expired and role[d] == LEADER:
             heartbeat[d] = True
             deadline[d] = clock[d] + cfg.heartbeat_ticks

@@ -24,6 +24,18 @@ CONFIGS = [
         ),
         id="n7-faults",
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=4,
+            drop_prob=0.1,
+            crash_prob=0.5,
+            crash_period=20,
+            crash_down_ticks=10,
+        ),
+        id="n5-crashes",
+    ),
 ]
 
 

@@ -35,6 +35,8 @@ def quiet_inputs(cfg, far=1000):
         skew=jnp.ones((n,), jnp.int32),
         timeout_draw=jnp.full((n,), far, jnp.int32),
         client_cmd=jnp.int32(NIL),
+        alive=jnp.ones((n,), bool),
+        restarted=jnp.zeros((n,), bool),
     )
 
 
@@ -437,3 +439,75 @@ def test_client_command_lands_on_leader_only():
     assert int(s2.log_len[0]) == 1
     assert int(s2.log_val[0, 0]) == 42
     assert all(int(x) == 0 for x in np.asarray(s2.log_len[1:]))
+
+
+# ---------------------------------------------------------- crash/restart fault tests
+
+
+def test_restart_wipes_volatile_keeps_persistent():
+    """Restart keeps the Raft persistent triple (currentTerm, votedFor, log[]) and
+    wipes everything else (fig. 2 state table) -- unlike the reference, where only
+    committed values survive a process death (log.clj:16-18, bug 2.3.12)."""
+    s = with_log(base_state(), 0, [1, 2, 2])
+    s = make_leader(s, 0, 2)
+    s = s._replace(
+        voted_for=s.voted_for.at[0].set(0),
+        votes=s.votes.at[0].set(jnp.ones((5,), bool)),
+        match_index=s.match_index.at[0].set(jnp.full((5,), 3, jnp.int32)),
+        commit_index=s.commit_index.at[0].set(3),
+    )
+    inp = quiet_inputs(CFG)._replace(restarted=jnp.zeros((5,), bool).at[0].set(True))
+    s2, info = step(CFG, s, inp)
+    # Persistent: term, vote, log survive.
+    assert int(s2.term[0]) == 2
+    assert int(s2.voted_for[0]) == 0
+    assert int(s2.log_len[0]) == 3
+    np.testing.assert_array_equal(np.asarray(s2.log_term[0, :3]), [1, 2, 2])
+    # Volatile: role, leader bookkeeping, commit, votes wiped.
+    assert int(s2.role[0]) == FOLLOWER
+    assert int(s2.leader_id[0]) == NIL
+    assert int(s2.commit_index[0]) == 0
+    assert int(np.asarray(s2.votes[0]).sum()) == 0
+    assert all(int(x) == 1 for x in np.asarray(s2.next_index[0]))
+    assert all(int(x) == 0 for x in np.asarray(s2.match_index[0]))
+    # The commit wipe is a restart, not a monotonicity violation.
+    assert not bool(info.viol_commit)
+
+
+def test_down_leader_is_silent():
+    """A crashed leader fires no heartbeat and emits nothing, so followers' election
+    timers run out (the reference analogue: a killed process's peers see timeouts)."""
+    s = make_leader(base_state(), 0, 1)
+    s = s._replace(deadline=s.deadline.at[0].set(1))  # heartbeat due now
+    inp = quiet_inputs(CFG)._replace(alive=jnp.ones((5,), bool).at[0].set(False))
+    s2, _ = step(CFG, s, inp)
+    assert int(np.asarray(s2.mailbox.req_type).sum()) == 0  # nothing sent
+    assert int(s2.role[0]) == LEADER  # state frozen, not demoted, while down
+    assert int(s2.deadline[0]) == 1  # timer did not fire or reset
+
+
+def test_down_node_receives_nothing():
+    """Messages to a down node die in flight: no response, no vote, no term adoption."""
+    s = base_state()
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[1, 0].set(5),
+    )
+    inp = quiet_inputs(CFG)._replace(alive=jnp.ones((5,), bool).at[1].set(False))
+    s2, _ = step(CFG, s._replace(mailbox=mb), inp)
+    assert int(s2.term[1]) == 1
+    assert int(s2.voted_for[1]) == NIL
+    assert int(s2.mailbox.resp_type[0, 1]) == 0
+
+
+def test_down_candidate_cannot_win_on_banked_votes():
+    s = base_state()
+    s = s._replace(
+        role=s.role.at[0].set(CANDIDATE),
+        term=s.term.at[0].set(2),
+        voted_for=s.voted_for.at[0].set(0),
+        votes=s.votes.at[0].set(jnp.ones((5,), bool)),
+    )
+    inp = quiet_inputs(CFG)._replace(alive=jnp.ones((5,), bool).at[0].set(False))
+    s2, _ = step(CFG, s, inp)
+    assert int(s2.role[0]) == CANDIDATE  # not leader while down

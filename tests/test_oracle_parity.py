@@ -54,6 +54,19 @@ CONFIGS = [
         3,
         id="n4-partitions",
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=3,
+            drop_prob=0.1,
+            crash_prob=0.5,
+            crash_period=20,
+            crash_down_ticks=10,
+        ),
+        4,
+        id="n5-crashes",
+    ),
 ]
 
 
