@@ -356,7 +356,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         mailbox=new_mb,
     )
 
-    info = _step_info(cfg, s, new_state, req_in, resp_in, inp.alive)
+    info = _step_info(cfg, s, new_state, req_in, resp_in, inp.alive, do_inject)
     return new_state, info
 
 
@@ -367,6 +367,7 @@ def _step_info(
     req_in: jax.Array,
     resp_in: jax.Array,
     alive: jax.Array,
+    do_inject: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
     n = cfg.n_nodes
@@ -389,21 +390,32 @@ def _step_info(
             & ~eye
         )
         viol_election = jnp.any(pair_bad)
-        # Commit sanity: monotonic and within the log.
-        viol_commit = jnp.any(
-            (new.commit_index < old.commit_index) | (new.commit_index > new.log_len)
+        # Commit sanity: monotonic, within the log, and the committed prefix is
+        # immutable -- entries below the old commit index never change term OR value
+        # (state-machine-safety analogue of the reference's apply-entries! writing
+        # committed values to an append-only file, log.clj:69-76).
+        ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
+        was_committed = ks[None, :] < old.commit_index[:, None]
+        rewrote = was_committed & (
+            (new.log_term != old.log_term) | (new.log_val != old.log_val)
         )
+        viol_commit = jnp.any(
+            (new.commit_index < old.commit_index)
+            | (new.commit_index > new.log_len)
+        ) | jnp.any(rewrote)
     else:
         viol_election = f
         viol_commit = f
 
     if cfg.check_log_matching:
-        # Log matching on committed prefixes: any two nodes agree on every entry up to
-        # min(commit_i, commit_j). O(N^2 * CAP) -- gated by config.
+        # Log matching on committed prefixes: any two nodes agree on every entry
+        # (term AND value) up to min(commit_i, commit_j). O(N^2 * CAP) -- gated.
         minc = jnp.minimum(new.commit_index[:, None], new.commit_index[None, :])
         ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
         both = ks[None, None, :] < minc[:, :, None]
-        differ = new.log_term[:, None, :] != new.log_term[None, :, :]
+        differ = (new.log_term[:, None, :] != new.log_term[None, :, :]) | (
+            new.log_val[:, None, :] != new.log_val[None, :, :]
+        )
         viol_match = jnp.any(both & differ)
     else:
         viol_match = f
@@ -419,4 +431,8 @@ def _step_info(
         max_commit=jnp.max(new.commit_index),
         min_commit=jnp.min(new.commit_index),
         msgs_delivered=(jnp.sum(req_in) + jnp.sum(resp_in)).astype(jnp.int32),
+        # any(), not sum(): during a split-brain window two live leaders can both
+        # accept the same offered command; that is ONE offer accepted, and the
+        # offered-vs-committed audit (tests/test_completeness.py) counts offers.
+        cmds_injected=jnp.any(do_inject).astype(jnp.int32),
     )

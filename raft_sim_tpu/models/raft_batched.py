@@ -301,7 +301,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         mailbox=new_mb,
     )
 
-    info = _step_info_b(cfg, s, new_state, req_in, resp_in, inp.alive)
+    info = _step_info_b(cfg, s, new_state, req_in, resp_in, inp.alive, do_inject)
     return new_state, info
 
 
@@ -312,6 +312,7 @@ def _step_info_b(
     req_in: jax.Array,
     resp_in: jax.Array,
     alive: jax.Array,
+    do_inject: jax.Array,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
@@ -330,10 +331,14 @@ def _step_info_b(
             & ~eye3
         )
         viol_election = jnp.any(pair_bad, axis=(0, 1))
+        was_committed = iota((1, cfg.log_capacity, 1), 1) < old.commit_index[:, None, :]
+        rewrote = was_committed & (
+            (new.log_term != old.log_term) | (new.log_val != old.log_val)
+        )
         viol_commit = jnp.any(
             (new.commit_index < old.commit_index) | (new.commit_index > new.log_len),
             axis=0,
-        )
+        ) | jnp.any(rewrote, axis=(0, 1))
     else:
         viol_election = f
         viol_commit = f
@@ -341,7 +346,9 @@ def _step_info_b(
     if cfg.check_log_matching:
         minc = jnp.minimum(new.commit_index[:, None, :], new.commit_index[None, :, :])
         both = iota((1, 1, cfg.log_capacity, 1), 2) < minc[:, :, None, :]
-        differ = new.log_term[:, None] != new.log_term[None, :]
+        differ = (new.log_term[:, None] != new.log_term[None, :]) | (
+            new.log_val[:, None] != new.log_val[None, :]
+        )
         viol_match = jnp.any(both & differ, axis=(0, 1, 2))
     else:
         viol_match = f
@@ -359,4 +366,5 @@ def _step_info_b(
         msgs_delivered=(
             jnp.sum(req_in, axis=(0, 1)) + jnp.sum(resp_in, axis=(0, 1))
         ).astype(jnp.int32),
+        cmds_injected=jnp.any(do_inject, axis=0).astype(jnp.int32),  # offers, not leaders; see raft.py
     )

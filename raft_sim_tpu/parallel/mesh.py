@@ -97,6 +97,7 @@ class FleetSummary(NamedTuple):
     p50_stable_tick: float | None  # median ticks-to-stable-leader; None if no cluster stabilized
     max_term: int
     total_msgs: int
+    total_cmds: int  # client commands accepted fleet-wide (offered vs committed audit)
 
 
 def summarize(metrics) -> FleetSummary:
@@ -116,4 +117,5 @@ def summarize(metrics) -> FleetSummary:
         p50_stable_tick=p50,
         max_term=int(np.max(m.max_term)),
         total_msgs=int(np.sum(m.total_msgs, dtype=np.int64)),
+        total_cmds=int(np.sum(m.total_cmds, dtype=np.int64)),
     )

@@ -34,6 +34,7 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
         max_commit=jnp.maximum(a.max_commit, b.max_commit),
         min_commit=b.min_commit,  # "at final tick" -> later segment wins
         total_msgs=a.total_msgs + b.total_msgs,
+        total_cmds=a.total_cmds + b.total_cmds,
         ticks=a.ticks + b.ticks,
     )
 

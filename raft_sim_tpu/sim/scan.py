@@ -47,6 +47,7 @@ class RunMetrics(NamedTuple):
     max_commit: jax.Array  # int32
     min_commit: jax.Array  # int32: min over nodes at the final tick
     total_msgs: jax.Array  # int32: delivered records over the run
+    total_cmds: jax.Array  # int32: client commands accepted by a live leader
     ticks: jax.Array  # int32
 
 
@@ -65,6 +66,7 @@ def init_metrics() -> RunMetrics:
         max_commit=z,
         min_commit=z,
         total_msgs=z,
+        total_cmds=z,
         ticks=z,
     )
 
@@ -84,6 +86,7 @@ def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
         max_commit=jnp.maximum(m.max_commit, info.max_commit),
         min_commit=info.min_commit,
         total_msgs=m.total_msgs + info.msgs_delivered,
+        total_cmds=m.total_cmds + info.cmds_injected,
         ticks=m.ticks + 1,
     )
 

@@ -34,6 +34,7 @@ def info_lines(infos, every: int = 1) -> Iterator[str]:
             f"  max_term={int(f['max_term'][t])}"
             f"  commit[{int(f['min_commit'][t])},{int(f['max_commit'][t])}]"
             f"  msgs={int(f['msgs_delivered'][t])}"
+            f"  cmds={int(f['cmds_injected'][t])}"
             + ("  VIOLATION" if bool(viol[t]) else "")
         )
 

@@ -127,6 +127,7 @@ class StepInfo(NamedTuple):
     max_commit: jax.Array  # int32
     min_commit: jax.Array  # int32
     msgs_delivered: jax.Array  # int32: request+response records delivered this tick
+    cmds_injected: jax.Array  # int32 0/1: an offered command was accepted by a live leader
 
 
 def empty_mailbox(cfg: RaftConfig) -> Mailbox:

@@ -27,7 +27,8 @@ from raft_sim_tpu.types import ClusterState, Mailbox
 from raft_sim_tpu.utils.config import RaftConfig
 
 # v2: added the session seed to the archive.
-_FORMAT_VERSION = 2
+# v3: RunMetrics gained total_cmds.
+_FORMAT_VERSION = 3
 
 
 def _normalize(path: str) -> str:

