@@ -70,7 +70,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     ids = jnp.arange(n, dtype=jnp.int32)
     eye = jnp.eye(n, dtype=bool)
-    src_ids = jnp.broadcast_to(ids[None, :], (n, n))  # [dst, src] -> src id
+    snd_ids = jnp.broadcast_to(ids[:, None], (n, n))  # [sender, receiver] -> sender id
 
     # ---- phase -1: restart (crash fault) -----------------------------------------
     # A node restarting this tick rejoins as a fresh follower: the Raft persistent
@@ -98,17 +98,22 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # dies with it (the crashed process's sockets). Mailbox slots hold messages sent
     # last tick, so a node that just restarted must also not see them -- they were
     # addressed to a dead process (alive now & alive at send time = alive & ~restarted).
+    # The input mask is indexed by physical directed edge [to, from]; request fields
+    # are stored [sender, receiver] (= [from, to], Mailbox docstring) so requests
+    # read it transposed; response fields are [receiver, responder] (= [to, from])
+    # and read it directly.
     dst_up = inp.alive & ~inp.restarted
-    deliver = inp.deliver_mask & ~eye & dst_up[:, None] & inp.alive[None, :]
-    req_in = deliver & (mb.req_type != 0)  # [dst, src]
-    resp_in = deliver & (mb.resp_type != 0)
+    deliver_req = inp.deliver_mask.T & ~eye & inp.alive[:, None] & dst_up[None, :]
+    deliver_resp = inp.deliver_mask & ~eye & dst_up[:, None] & inp.alive[None, :]
+    req_in = deliver_req & (mb.req_type != 0)  # [sender, receiver]
+    resp_in = deliver_resp & (mb.resp_type != 0)  # [receiver, responder]
 
     # ---- phase 1: term adoption --------------------------------------------------
     # Spec: any RPC (request or response) with term T > currentTerm -> set
     # currentTerm = T, convert to follower. The reference does this for responses
     # (core.clj:129-130, 144-145) but not vote requests (bug 2.3.2).
     in_term = jnp.maximum(
-        jnp.max(jnp.where(req_in, mb.req_term, 0), axis=1),
+        jnp.max(jnp.where(req_in, mb.req_term, 0), axis=0),
         jnp.max(jnp.where(resp_in, mb.resp_term, 0), axis=1),
     )  # [N]
     saw_higher = in_term > s.term
@@ -121,47 +126,56 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     my_last_idx, my_last_term = log_ops.last_index_term(s.log_term, s.log_len)
 
     # ---- phase 2: RequestVote requests (request-vote-handler, core.clj:91-103) ----
-    is_rv = req_in & (mb.req_type == REQ_VOTE)
-    cur_rv = is_rv & (mb.req_term == term[:, None])  # stale-term requests are denied
+    is_rv = req_in & (mb.req_type == REQ_VOTE)  # [candidate, voter]
+    cur_rv = is_rv & (mb.req_term == term[None, :])  # stale-term requests are denied
     # Spec 5.4.1 up-to-date check (the reference's compare-prev? log.clj:55-59 compares
     # against the commit index and whole entry maps -- bugs 2.3.3/2.3.4).
-    up_to_date = (mb.req_prev_term > my_last_term[:, None]) | (
-        (mb.req_prev_term == my_last_term[:, None])
-        & (mb.req_prev_index >= my_last_idx[:, None])
+    up_to_date = (mb.req_prev_term > my_last_term[None, :]) | (
+        (mb.req_prev_term == my_last_term[None, :])
+        & (mb.req_prev_index >= my_last_idx[None, :])
     )
     can_grant = cur_rv & up_to_date
     # At most one grant per node per tick: the lowest eligible candidate id wins the
     # race (the reference serializes naturally, one message per wait iteration).
-    lowest = jnp.min(jnp.where(can_grant, src_ids, n), axis=1)  # [N], n = none
+    lowest = jnp.min(jnp.where(can_grant, snd_ids, n), axis=0)  # [N], n = none
     grant = jnp.where(
-        (voted_for != NIL)[:, None],
-        can_grant & (src_ids == voted_for[:, None]),  # idempotent re-grant
-        can_grant & (src_ids == lowest[:, None]),
+        (voted_for != NIL)[None, :],
+        can_grant & (snd_ids == voted_for[None, :]),  # idempotent re-grant
+        can_grant & (snd_ids == lowest[None, :]),
     )
-    granted_any = jnp.any(grant, axis=1)
+    granted_any = jnp.any(grant, axis=0)
     voted_for = jnp.where((voted_for == NIL) & granted_any, lowest, voted_for)
-    # Every delivered RV gets a response carrying our (possibly just-adopted) term.
-    vr_out = is_rv  # [dst, src] -- response to src
+    # Every delivered RV gets a response carrying our (possibly just-adopted) term;
+    # [candidate, voter] is already the response orientation [receiver, responder].
+    vr_out = is_rv
     vr_granted = grant
 
     # ---- phase 3: AppendEntries requests (append-entries-handler, core.clj:105-123) --
-    is_ae = req_in & (mb.req_type == REQ_APPEND)
-    cur_ae = is_ae & (mb.req_term == term[:, None])
+    is_ae = req_in & (mb.req_type == REQ_APPEND)  # [leader, follower]
+    cur_ae = is_ae & (mb.req_term == term[None, :])
     # Election safety gives at most one leader per term, so at most one current-term AE
     # sender exists; pick the lowest id defensively (ties indicate a safety violation,
     # which phase 9 flags).
-    ae_src = jnp.min(jnp.where(cur_ae, src_ids, n), axis=1)  # [N]
+    ae_src = jnp.min(jnp.where(cur_ae, snd_ids, n), axis=0)  # [N]
     has_ae = ae_src < n
-    sel = cur_ae & (src_ids == ae_src[:, None])  # one-hot [dst, src]
+    sel = cur_ae & (snd_ids == ae_src[None, :])  # one-hot [sender, receiver]
 
-    pick = lambda f: jnp.sum(jnp.where(sel, f, 0), axis=1)  # [N]
+    pick = lambda f: jnp.sum(jnp.where(sel, f, 0), axis=0)  # [N]
     prev_i = pick(mb.req_prev_index)
     prev_t = pick(mb.req_prev_term)
     lcommit = pick(mb.req_commit)
     n_ent = pick(mb.req_n_ent)
+    # Selected sender's SHARED entry window (src-indexed; Mailbox docstring), rebased
+    # at this receiver's own prev index: off = prev_i - ent_start[src] is in [0, E-1]
+    # whenever n_ent > 0; reads past the window only occur at masked (k >= n_ent)
+    # positions, where the clipped gather returns the last slot harmlessly.
     sel_idx = jnp.minimum(ae_src, n - 1)
-    ent_term_in = jnp.take_along_axis(mb.req_ent_term, sel_idx[:, None, None], axis=1)[:, 0]
-    ent_val_in = jnp.take_along_axis(mb.req_ent_val, sel_idx[:, None, None], axis=1)[:, 0]
+    w_term = mb.ent_term[sel_idx]  # [N, E]
+    w_val = mb.ent_val[sel_idx]
+    ws_in = mb.ent_start[sel_idx]  # [N]
+    off = jnp.clip(prev_i - ws_in, 0, e - 1)
+    ent_term_in = log_ops.window(w_term, off, e)  # [N, E]
+    ent_val_in = log_ops.window(w_val, off, e)
 
     # A valid AE from the current term makes candidates step down and identifies the
     # leader (core.clj:121-123, minus the :follwer typo, bug 2.3.1).
@@ -202,9 +216,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     )
 
     # Respond to every delivered AE; success only for the selected, consistent one.
+    # [leader, follower] is already the response orientation [receiver, responder].
     ar_out = is_ae
-    ar_success = sel & ae_ok[:, None]
-    ar_match = jnp.where(ar_success, last_new[:, None], 0)
+    ar_success = sel & ae_ok[None, :]
+    ar_match = jnp.where(ar_success, last_new[None, :], 0)
 
     # ---- phase 4: responses ------------------------------------------------------
     # Vote tally (vote-response-handler core.clj:125-139; dedup via bitmap mirrors the
@@ -294,44 +309,58 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     send_append = win | heartbeat  # fresh leaders heartbeat immediately (core.clj:137-138)
     new_last_idx, new_last_term = log_ops.last_index_term(log_term_arr, log_len)
 
-    # Requests, built [src, dst] then transposed to the mailbox's [dst, src].
+    # Requests are built [sender, receiver] -- exactly the mailbox orientation, so
+    # no transposes are needed anywhere in the outbox (Mailbox docstring).
     rv_edge = start_election[:, None] & ~eye  # request-vote-rpc core.clj:48-54
     ae_edge = send_append[:, None] & ~eye  # append-entries-rpc core.clj:56-67
     out_req_type = jnp.where(rv_edge, REQ_VOTE, jnp.where(ae_edge, REQ_APPEND, 0))
     out_req_term = jnp.broadcast_to(term[:, None], (n, n))
-    # AE slice: prev = nextIndex - 1, window of up to E entries from prev.
+    # AE headers: prev = nextIndex - 1 per edge; the entry payload is ONE shared
+    # window per sender starting at the minimum peer prev (Mailbox docstring), so the
+    # per-edge n_ent counts only the entries available to that peer within it.
     prev_out = jnp.clip(next_index - 1, 0, log_len[:, None])  # [src, dst]
-    n_out = jnp.clip(log_len[:, None] - prev_out, 0, e)
+    ws = jnp.min(jnp.where(eye, cap, prev_out), axis=1)  # [src] shared window start
+    ws = jnp.minimum(ws, log_len)
+    # Clamp each peer's prev into [ws, ws+E]: spec-safe (a peer ahead of the window
+    # gets a plain heartbeat over an older prefix it already has; its redundant ack
+    # is absorbed by the monotone max() updates of match/next in phase 4), and it
+    # bounds prev - ws to E+1 values so the batch-minor kernel can read prev terms
+    # from the shared window instead of a CAP-wide one-hot per edge.
+    prev_out = jnp.clip(prev_out, ws[:, None], (ws + e)[:, None])
+    w_end = jnp.minimum(log_len, ws + e)  # [src] exclusive window end
+    n_out = jnp.clip(w_end[:, None] - prev_out, 0, e)
     out_prev_term_ae = log_ops.term_at(log_term_arr, prev_out)
     out_req_prev_index = jnp.where(rv_edge, new_last_idx[:, None], prev_out)
     out_req_prev_term = jnp.where(rv_edge, new_last_term[:, None], out_prev_term_ae)
     out_req_commit = jnp.broadcast_to(commit[:, None], (n, n))
     out_req_n_ent = jnp.where(ae_edge, n_out, 0)
-    # Zero entry slots beyond n_out so the mailbox is canonical (receivers mask with
+    # Zero unused window slots so the mailbox is canonical (receivers mask with
     # n_ent anyway, but a canonical wire format keeps trajectories bit-comparable).
-    ent_used = ks[None, None, :] < n_out[:, :, None]  # [src, dst, E]
-    out_ent_term = jnp.where(ent_used, log_ops.window(log_term_arr, prev_out, e), 0)
-    out_ent_val = jnp.where(ent_used, log_ops.window(log_val_arr, prev_out, e), 0)
+    n_ship = jnp.clip(log_len - ws, 0, e)  # [src]
+    ship_used = send_append[:, None] & (ks[None, :] < n_ship[:, None])  # [src, E]
+    out_ent_start = jnp.where(send_append, ws, 0)
+    out_ent_term = jnp.where(ship_used, log_ops.window(log_term_arr, ws, e), 0)
+    out_ent_val = jnp.where(ship_used, log_ops.window(log_val_arr, ws, e), 0)
 
-    # Responses: vr_out/ar_out are [dst_of_request, src_of_request]; the response
-    # travels back src<->dst, i.e. a transpose (the reference's resp-chan round trip,
-    # server.clj:59-60 -> client.clj:34-40).
-    out_resp_type = (
-        jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    ).T
-    out_resp_term = jnp.broadcast_to(term[:, None], (n, n)).T
-    out_resp_ok = (vr_granted | ar_success).T
-    out_resp_match = ar_match.T
+    # Responses: vr_out/ar_out are [request-sender, request-receiver], which IS the
+    # response orientation [response-receiver, responder] (the reference's resp-chan
+    # round trip, server.clj:59-60 -> client.clj:34-40); the responder's term rides
+    # along axis 1.
+    out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
+    out_resp_term = jnp.broadcast_to(term[None, :], (n, n))
+    out_resp_ok = vr_granted | ar_success
+    out_resp_match = ar_match
 
     new_mb = Mailbox(
-        req_type=out_req_type.T,
-        req_term=jnp.where(out_req_type != 0, out_req_term, 0).T,
-        req_prev_index=jnp.where(out_req_type != 0, out_req_prev_index, 0).T,
-        req_prev_term=jnp.where(out_req_type != 0, out_req_prev_term, 0).T,
-        req_commit=jnp.where(ae_edge, out_req_commit, 0).T,
-        req_n_ent=out_req_n_ent.T,
-        req_ent_term=jnp.where(ae_edge[..., None], out_ent_term, 0).swapaxes(0, 1),
-        req_ent_val=jnp.where(ae_edge[..., None], out_ent_val, 0).swapaxes(0, 1),
+        req_type=out_req_type,
+        req_term=jnp.where(out_req_type != 0, out_req_term, 0),
+        req_prev_index=jnp.where(out_req_type != 0, out_req_prev_index, 0),
+        req_prev_term=jnp.where(out_req_type != 0, out_req_prev_term, 0),
+        req_commit=jnp.where(ae_edge, out_req_commit, 0),
+        req_n_ent=out_req_n_ent,
+        ent_start=out_ent_start,
+        ent_term=out_ent_term,
+        ent_val=out_ent_val,
         resp_type=out_resp_type,
         resp_term=jnp.where(out_resp_type != 0, out_resp_term, 0),
         resp_ok=out_resp_ok,

@@ -47,29 +47,53 @@ NIL = -1  # nil node id
 
 
 class Mailbox(NamedTuple):
-    """One in-flight RPC slot per directed edge, indexed [dst, src].
+    """One in-flight RPC slot per directed edge. Index orientation is chosen so that
+    every outbox write is transpose-free (transposing ten [N, N, batch] fields per
+    tick was ~15% of the N=51 tick):
 
-    Request fields overlay both message types (reference wire formats core.clj:51-54 and
-    core.clj:62-67):
+      req_*  fields: [sender, receiver]   -- a sender broadcasts along its row;
+                                             receivers reduce over axis 0.
+      resp_* fields: [receiver, responder] -- a responder answers the request slot
+                                             [q, r] it was addressed in, so the
+                                             response to q lands at [q, r] directly;
+                                             requesters reduce over axis 1.
+
+    The AppendEntries entry payload is *shared per sender* (src-indexed).
+
+    Request header fields overlay both message types (reference wire formats
+    core.clj:51-54 and core.clj:62-67):
       REQ_VOTE:   prev_index = last-log-index, prev_term = last-log-term
-      REQ_APPEND: prev_index/prev_term/commit/n_ent/ent_term/ent_val as named
+      REQ_APPEND: prev_index/prev_term/commit/n_ent as named
+
+    Entry transport (TPU-native wire-format deviation from the reference, which ships
+    an arbitrary per-peer log suffix, core.clj:59-67): a sender broadcasts ONE shared
+    E-entry window of its log per tick -- `ent_term/ent_val` [N(src), E] starting at
+    1-based index `ent_start[src] + 1` -- positioned at the *minimum* prev-index among
+    its peers. Each receiver rebases into the shared window at offset
+    (own prev_index - ent_start); the per-edge `req_n_ent` header already counts only
+    the entries available to that receiver. Spec-equivalent (AppendEntries may carry
+    any window the receiver validates against prev_index/prev_term) but the mailbox
+    payload is O(N*E) instead of O(N^2*E) -- at N=51 the per-edge form was ~70% of all
+    mailbox bytes and the dominant HBM traffic of the whole tick.
+
     Response fields overlay :vote-response {term,vote-granted} (core.clj:95-102) and
     :append-response {term,success,log-index} (core.clj:109-121): `ok` is
     granted/success, `match` is the acknowledged log index for successful appends.
     """
 
-    req_type: jax.Array  # [N, N] int32 (REQ_*)
-    req_term: jax.Array  # [N, N] int32
-    req_prev_index: jax.Array  # [N, N] int32
-    req_prev_term: jax.Array  # [N, N] int32
-    req_commit: jax.Array  # [N, N] int32
-    req_n_ent: jax.Array  # [N, N] int32
-    req_ent_term: jax.Array  # [N, N, E] int32
-    req_ent_val: jax.Array  # [N, N, E] int32
-    resp_type: jax.Array  # [N, N] int32 (RESP_*)
-    resp_term: jax.Array  # [N, N] int32
-    resp_ok: jax.Array  # [N, N] bool
-    resp_match: jax.Array  # [N, N] int32
+    req_type: jax.Array  # [N(sender), N(receiver)] int32 (REQ_*)
+    req_term: jax.Array  # [sender, receiver] int32
+    req_prev_index: jax.Array  # [sender, receiver] int32
+    req_prev_term: jax.Array  # [sender, receiver] int32
+    req_commit: jax.Array  # [sender, receiver] int32
+    req_n_ent: jax.Array  # [sender, receiver] int32
+    ent_start: jax.Array  # [N] int32: 0-based slot where src's shared window starts
+    ent_term: jax.Array  # [N, E] int32: src's shared entry window (terms)
+    ent_val: jax.Array  # [N, E] int32: src's shared entry window (values)
+    resp_type: jax.Array  # [N(receiver), N(responder)] int32 (RESP_*)
+    resp_term: jax.Array  # [receiver, responder] int32
+    resp_ok: jax.Array  # [receiver, responder] bool
+    resp_match: jax.Array  # [receiver, responder] int32
 
 
 class ClusterState(NamedTuple):
@@ -140,8 +164,9 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         req_prev_term=i(n, n),
         req_commit=i(n, n),
         req_n_ent=i(n, n),
-        req_ent_term=i(n, n, e),
-        req_ent_val=i(n, n, e),
+        ent_start=i(n),
+        ent_term=i(n, e),
+        ent_val=i(n, e),
         resp_type=i(n, n),
         resp_term=i(n, n),
         resp_ok=jnp.zeros((n, n), bool),

@@ -28,7 +28,9 @@ from raft_sim_tpu.utils.config import RaftConfig
 
 # v2: added the session seed to the archive.
 # v3: RunMetrics gained total_cmds.
-_FORMAT_VERSION = 3
+# v4: Mailbox entry payload became the per-sender shared window (ent_start/term/val).
+# v5: req_* fields reoriented [sender, receiver], resp_* [receiver, responder].
+_FORMAT_VERSION = 5
 
 
 def _normalize(path: str) -> str:

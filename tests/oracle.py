@@ -77,20 +77,22 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
 
     # ---- phase 0: delivery
-    deliver = np.asarray(inp["deliver_mask"], bool).copy()
-    np.fill_diagonal(deliver, False)
-    # dst must be alive now AND at send time (last tick): alive & ~restarted.
-    deliver &= (alive & ~restarted)[:, None] & alive[None, :]
-    req_in = deliver & (mb["req_type"] != 0)
-    resp_in = deliver & (mb["resp_type"] != 0)
+    # Input mask is per physical edge [to, from]; request fields are [sender,
+    # receiver] (mask transposed), response fields [receiver, responder] (direct).
+    # A receiver must be alive now AND at send time (last tick): alive & ~restarted.
+    edge_ok = np.asarray(inp["deliver_mask"], bool).copy()
+    np.fill_diagonal(edge_ok, False)
+    recv_up = alive & ~restarted
+    req_in = edge_ok.T & alive[:, None] & recv_up[None, :] & (mb["req_type"] != 0)
+    resp_in = edge_ok & recv_up[:, None] & alive[None, :] & (mb["resp_type"] != 0)
 
     # ---- phase 1: term adoption
     saw_higher = np.zeros(n, bool)
     for d in range(n):
         in_term = 0
         for src in range(n):
-            if req_in[d, src]:
-                in_term = max(in_term, int(mb["req_term"][d, src]))
+            if req_in[src, d]:
+                in_term = max(in_term, int(mb["req_term"][src, d]))
             if resp_in[d, src]:
                 in_term = max(in_term, int(mb["resp_term"][d, src]))
         if in_term > term[d]:
@@ -110,13 +112,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         my_last_term = term_at(s["log_term"][d], my_last_idx)
         can = []
         for src in range(n):
-            if not (req_in[d, src] and mb["req_type"][d, src] == REQ_VOTE):
+            if not (req_in[src, d] and mb["req_type"][src, d] == REQ_VOTE):
                 continue
             vr_out[d, src] = True
-            if mb["req_term"][d, src] != term[d]:
+            if mb["req_term"][src, d] != term[d]:
                 continue
-            c_idx = int(mb["req_prev_index"][d, src])
-            c_term = int(mb["req_prev_term"][d, src])
+            c_idx = int(mb["req_prev_index"][src, d])
+            c_term = int(mb["req_prev_term"][src, d])
             up_to_date = c_term > my_last_term or (
                 c_term == my_last_term and c_idx >= my_last_idx
             )
@@ -143,12 +145,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         cur = [
             src
             for src in range(n)
-            if req_in[d, src]
-            and mb["req_type"][d, src] == REQ_APPEND
+            if req_in[src, d]
+            and mb["req_type"][src, d] == REQ_APPEND
         ]
         for src in cur:
             ar_out[d, src] = True
-        cur_term = [src for src in cur if mb["req_term"][d, src] == term[d]]
+        cur_term = [src for src in cur if mb["req_term"][src, d] == term[d]]
         if not cur_term:
             continue
         src = min(cur_term)
@@ -157,12 +159,15 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             role[d] = FOLLOWER
         leader_id[d] = src
 
-        prev_i = int(mb["req_prev_index"][d, src])
-        prev_t = int(mb["req_prev_term"][d, src])
-        lcommit = int(mb["req_commit"][d, src])
-        n_ent = int(mb["req_n_ent"][d, src])
-        ent_t = mb["req_ent_term"][d, src]
-        ent_v = mb["req_ent_val"][d, src]
+        prev_i = int(mb["req_prev_index"][src, d])
+        prev_t = int(mb["req_prev_term"][src, d])
+        lcommit = int(mb["req_commit"][src, d])
+        n_ent = int(mb["req_n_ent"][src, d])
+        # Rebase the sender's shared window at this receiver's prev index (clipped
+        # reads past the window occur only at masked k >= n_ent positions).
+        off = int(prev_i) - int(mb["ent_start"][src])
+        ent_t = [int(mb["ent_term"][src, min(max(off, 0) + k, e - 1)]) for k in range(e)]
+        ent_v = [int(mb["ent_val"][src, min(max(off, 0) + k, e - 1)]) for k in range(e)]
 
         consistent = prev_i == 0 or (
             prev_i <= int(s["log_len"][d])
@@ -277,8 +282,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "req_prev_term": z(n, n),
         "req_commit": z(n, n),
         "req_n_ent": z(n, n),
-        "req_ent_term": z(n, n, e),
-        "req_ent_val": z(n, n, e),
+        "ent_start": z(n),
+        "ent_term": z(n, e),
+        "ent_val": z(n, e),
         "resp_type": z(n, n),
         "resp_term": z(n, n),
         "resp_ok": np.zeros((n, n), bool),
@@ -287,26 +293,37 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     for src in range(n):
         last_idx = int(log_len[src])
         last_term = term_at(log_term[src], last_idx)
+        if win[src] or heartbeat[src]:
+            # Shared entry window: starts at the minimum peer prev (Mailbox
+            # docstring); per-edge n_ent counts entries available to that peer.
+            prevs = [
+                min(max(int(next_index[src, dst]) - 1, 0), int(log_len[src]))
+                for dst in range(n)
+                if dst != src
+            ]
+            ws = min(min(prevs), int(log_len[src]))
+            w_end = min(int(log_len[src]), ws + e)
+            out["ent_start"][src] = ws
+            for k in range(w_end - ws):
+                out["ent_term"][src, k] = log_term[src, ws + k]
+                out["ent_val"][src, k] = log_val[src, ws + k]
         for dst in range(n):
             if dst == src:
                 continue
             if start_election[src]:
-                out["req_type"][dst, src] = REQ_VOTE
-                out["req_term"][dst, src] = term[src]
-                out["req_prev_index"][dst, src] = last_idx
-                out["req_prev_term"][dst, src] = last_term
+                out["req_type"][src, dst] = REQ_VOTE
+                out["req_term"][src, dst] = term[src]
+                out["req_prev_index"][src, dst] = last_idx
+                out["req_prev_term"][src, dst] = last_term
             elif win[src] or heartbeat[src]:
                 prev = min(max(int(next_index[src, dst]) - 1, 0), int(log_len[src]))
-                cnt = min(max(int(log_len[src]) - prev, 0), e)
-                out["req_type"][dst, src] = REQ_APPEND
-                out["req_term"][dst, src] = term[src]
-                out["req_prev_index"][dst, src] = prev
-                out["req_prev_term"][dst, src] = term_at(log_term[src], prev)
-                out["req_commit"][dst, src] = commit[src]
-                out["req_n_ent"][dst, src] = cnt
-                for k in range(cnt):
-                    out["req_ent_term"][dst, src, k] = log_term[src, prev + k]
-                    out["req_ent_val"][dst, src, k] = log_val[src, prev + k]
+                cnt = min(max(w_end - prev, 0), e)
+                out["req_type"][src, dst] = REQ_APPEND
+                out["req_term"][src, dst] = term[src]
+                out["req_prev_index"][src, dst] = prev
+                out["req_prev_term"][src, dst] = term_at(log_term[src], prev)
+                out["req_commit"][src, dst] = commit[src]
+                out["req_n_ent"][src, dst] = cnt
     # Responses travel back src<->dst: responder r answers requester q.
     for r in range(n):
         for q in range(n):

@@ -77,10 +77,10 @@ def test_vote_granted_and_term_adopted():
     2.3.2: it never did) and grant when the candidate's log is up to date."""
     s = base_state()
     mb = s.mailbox._replace(
-        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
-        req_term=s.mailbox.req_term.at[1, 0].set(5),
-        req_prev_index=s.mailbox.req_prev_index.at[1, 0].set(0),
-        req_prev_term=s.mailbox.req_prev_term.at[1, 0].set(0),
+        req_type=s.mailbox.req_type.at[0, 1].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[0, 1].set(5),
+        req_prev_index=s.mailbox.req_prev_index.at[0, 1].set(0),
+        req_prev_term=s.mailbox.req_prev_term.at[0, 1].set(0),
     )
     s2, _ = step(CFG, s._replace(mailbox=mb))
     assert int(s2.term[1]) == 5
@@ -94,8 +94,8 @@ def test_vote_denied_stale_term():
     s = base_state()
     s = s._replace(term=s.term.at[1].set(9))
     mb = s.mailbox._replace(
-        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
-        req_term=s.mailbox.req_term.at[1, 0].set(5),
+        req_type=s.mailbox.req_type.at[0, 1].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[0, 1].set(5),
     )
     s2, _ = step(CFG, s._replace(mailbox=mb))
     assert int(s2.voted_for[1]) == NIL
@@ -110,10 +110,10 @@ def test_vote_denied_stale_log():
     s = with_log(base_state(), 1, [1, 3])
     s = s._replace(term=s.term.at[1].set(4))
     mb = s.mailbox._replace(
-        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
-        req_term=s.mailbox.req_term.at[1, 0].set(4),
-        req_prev_index=s.mailbox.req_prev_index.at[1, 0].set(5),
-        req_prev_term=s.mailbox.req_prev_term.at[1, 0].set(2),
+        req_type=s.mailbox.req_type.at[0, 1].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[0, 1].set(4),
+        req_prev_index=s.mailbox.req_prev_index.at[0, 1].set(5),
+        req_prev_term=s.mailbox.req_prev_term.at[0, 1].set(2),
     )
     s2, _ = step(CFG, s._replace(mailbox=mb))
     assert not bool(s2.mailbox.resp_ok[0, 1])
@@ -125,10 +125,10 @@ def test_vote_denied_shorter_log_same_term():
     s = with_log(base_state(), 1, [2, 2, 2])
     s = s._replace(term=s.term.at[1].set(3))
     mb = s.mailbox._replace(
-        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
-        req_term=s.mailbox.req_term.at[1, 0].set(3),
-        req_prev_index=s.mailbox.req_prev_index.at[1, 0].set(2),
-        req_prev_term=s.mailbox.req_prev_term.at[1, 0].set(2),
+        req_type=s.mailbox.req_type.at[0, 1].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[0, 1].set(3),
+        req_prev_index=s.mailbox.req_prev_index.at[0, 1].set(2),
+        req_prev_term=s.mailbox.req_prev_term.at[0, 1].set(2),
     )
     s2, _ = step(CFG, s._replace(mailbox=mb))
     assert not bool(s2.mailbox.resp_ok[0, 1])
@@ -139,8 +139,8 @@ def test_single_vote_per_term_lowest_wins():
     remembered in voted_for."""
     s = base_state()
     mb = s.mailbox._replace(
-        req_type=s.mailbox.req_type.at[0, 2].set(REQ_VOTE).at[0, 3].set(REQ_VOTE),
-        req_term=s.mailbox.req_term.at[0, 2].set(2).at[0, 3].set(2),
+        req_type=s.mailbox.req_type.at[2, 0].set(REQ_VOTE).at[3, 0].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[2, 0].set(2).at[3, 0].set(2),
     )
     s2, _ = step(CFG, s._replace(mailbox=mb))
     assert int(s2.voted_for[0]) == 2
@@ -153,8 +153,8 @@ def test_revote_same_candidate_is_idempotent():
     s = base_state()
     s = s._replace(term=s.term.at[0].set(2), voted_for=s.voted_for.at[0].set(2))
     mb = s.mailbox._replace(
-        req_type=s.mailbox.req_type.at[0, 2].set(REQ_VOTE).at[0, 3].set(REQ_VOTE),
-        req_term=s.mailbox.req_term.at[0, 2].set(2).at[0, 3].set(2),
+        req_type=s.mailbox.req_type.at[2, 0].set(REQ_VOTE).at[3, 0].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[2, 0].set(2).at[3, 0].set(2),
     )
     s2, _ = step(CFG, s._replace(mailbox=mb))
     assert bool(s2.mailbox.resp_ok[2, 0])
@@ -165,20 +165,24 @@ def test_revote_same_candidate_is_idempotent():
 # ------------------------------------------------------------- AppendEntries handling
 
 
-def ae_mailbox(s, dst, src, term, prev_i, prev_t, commit, ents):
+def ae_mailbox(s, dst, src, term, prev_i, prev_t, commit, ents, ent_start=None):
+    """Wire an AppendEntries: per-edge header + the sender's shared entry window
+    (starting at `ent_start`, default = this receiver's prev, i.e. offset 0)."""
     mb = s.mailbox
+    start = prev_i if ent_start is None else ent_start
     mb = mb._replace(
-        req_type=mb.req_type.at[dst, src].set(REQ_APPEND),
-        req_term=mb.req_term.at[dst, src].set(term),
-        req_prev_index=mb.req_prev_index.at[dst, src].set(prev_i),
-        req_prev_term=mb.req_prev_term.at[dst, src].set(prev_t),
-        req_commit=mb.req_commit.at[dst, src].set(commit),
-        req_n_ent=mb.req_n_ent.at[dst, src].set(len(ents)),
+        req_type=mb.req_type.at[src, dst].set(REQ_APPEND),
+        req_term=mb.req_term.at[src, dst].set(term),
+        req_prev_index=mb.req_prev_index.at[src, dst].set(prev_i),
+        req_prev_term=mb.req_prev_term.at[src, dst].set(prev_t),
+        req_commit=mb.req_commit.at[src, dst].set(commit),
+        req_n_ent=mb.req_n_ent.at[src, dst].set(len(ents)),
+        ent_start=mb.ent_start.at[src].set(start),
     )
     for k, (t, v) in enumerate(ents):
         mb = mb._replace(
-            req_ent_term=mb.req_ent_term.at[dst, src, k].set(t),
-            req_ent_val=mb.req_ent_val.at[dst, src, k].set(v),
+            ent_term=mb.ent_term.at[src, (prev_i - start) + k].set(t),
+            ent_val=mb.ent_val.at[src, (prev_i - start) + k].set(v),
         )
     return s._replace(mailbox=mb)
 
@@ -282,7 +286,7 @@ def test_candidate_wins_with_quorum():
     assert all(int(x) == 0 for x in np.asarray(s2.match_index[0]))
     # Immediate heartbeat to all peers (core.clj:137-138).
     for p in range(1, 5):
-        assert int(s2.mailbox.req_type[p, 0]) == REQ_APPEND
+        assert int(s2.mailbox.req_type[0, p]) == REQ_APPEND
     assert int(info.n_leaders) == 1
 
 
@@ -398,8 +402,8 @@ def test_timeout_starts_election():
     assert int(s2.voted_for[2]) == 2
     assert bool(s2.votes[2, 2])
     for p in [0, 1, 3, 4]:
-        assert int(s2.mailbox.req_type[p, 2]) == REQ_VOTE
-        assert int(s2.mailbox.req_term[p, 2]) == 2
+        assert int(s2.mailbox.req_type[2, p]) == REQ_VOTE
+        assert int(s2.mailbox.req_term[2, p]) == 2
 
 
 def test_leader_heartbeats_on_timer():
@@ -412,8 +416,8 @@ def test_leader_heartbeats_on_timer():
     )
     s2, _ = step(CFG, s)
     for p in range(1, 5):
-        assert int(s2.mailbox.req_type[p, 0]) == REQ_APPEND
-        assert int(s2.mailbox.req_n_ent[p, 0]) == 1
+        assert int(s2.mailbox.req_type[0, p]) == REQ_APPEND
+        assert int(s2.mailbox.req_n_ent[0, p]) == 1
     assert int(s2.deadline[0]) == int(s2.clock[0]) + CFG.heartbeat_ticks
 
 
@@ -422,8 +426,8 @@ def test_dropped_messages_are_dropped():
     exception, client.clj:38-40)."""
     s = base_state()
     mb = s.mailbox._replace(
-        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
-        req_term=s.mailbox.req_term.at[1, 0].set(5),
+        req_type=s.mailbox.req_type.at[0, 1].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[0, 1].set(5),
     )
     inp = quiet_inputs(CFG)
     inp = inp._replace(deliver_mask=inp.deliver_mask.at[1, 0].set(False))
@@ -490,8 +494,8 @@ def test_down_node_receives_nothing():
     """Messages to a down node die in flight: no response, no vote, no term adoption."""
     s = base_state()
     mb = s.mailbox._replace(
-        req_type=s.mailbox.req_type.at[1, 0].set(REQ_VOTE),
-        req_term=s.mailbox.req_term.at[1, 0].set(5),
+        req_type=s.mailbox.req_type.at[0, 1].set(REQ_VOTE),
+        req_term=s.mailbox.req_term.at[0, 1].set(5),
     )
     inp = quiet_inputs(CFG)._replace(alive=jnp.ones((5,), bool).at[1].set(False))
     s2, _ = step(CFG, s._replace(mailbox=mb), inp)
@@ -511,3 +515,27 @@ def test_down_candidate_cannot_win_on_banked_votes():
     inp = quiet_inputs(CFG)._replace(alive=jnp.ones((5,), bool).at[0].set(False))
     s2, _ = step(CFG, s, inp)
     assert int(s2.role[0]) == CANDIDATE  # not leader while down
+
+
+def test_append_shared_window_rebase():
+    """The shared-window wire format: a receiver whose prev is PAST the window start
+    rebases into the sender's shared window (offset > 0) and appends the right
+    entries (Mailbox docstring; the per-edge-window form this replaced was the N^2
+    mailbox bandwidth hog)."""
+    s = with_log(base_state(), 1, [1])  # receiver already has entry 1
+    s = s._replace(term=s.term.at[1].set(2))
+    # Sender's shared window starts at slot 0 holding [(1,100), (2,7)]; this
+    # receiver's prev is 1, so only (2,7) at window offset 1 is for it.
+    s = ae_mailbox(
+        s, 1, 0, term=2, prev_i=1, prev_t=1, commit=0,
+        ents=[(2, 7)], ent_start=0,
+    )
+    mb = s.mailbox._replace(
+        ent_term=s.mailbox.ent_term.at[0, 0].set(1),
+        ent_val=s.mailbox.ent_val.at[0, 0].set(100),
+    )
+    s2, _ = step(CFG, s._replace(mailbox=mb))
+    assert bool(s2.mailbox.resp_ok[0, 1])
+    assert int(s2.log_len[1]) == 2
+    np.testing.assert_array_equal(np.asarray(s2.log_term[1, :2]), [1, 2])
+    np.testing.assert_array_equal(np.asarray(s2.log_val[1, :2]), [100, 7])
