@@ -317,6 +317,10 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 out["req_prev_term"][src, dst] = last_term
             elif win[src] or heartbeat[src]:
                 prev = min(max(int(next_index[src, dst]) - 1, 0), int(log_len[src]))
+                # Clamp into [ws, ws+E] to match the kernel: a peer ahead of the
+                # shared window gets a heartbeat over an older prefix (spec-safe;
+                # its redundant ack is absorbed by the monotone match/next max).
+                prev = min(max(prev, ws), ws + e)
                 cnt = min(max(w_end - prev, 0), e)
                 out["req_type"][src, dst] = REQ_APPEND
                 out["req_term"][src, dst] = term[src]
