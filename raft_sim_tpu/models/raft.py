@@ -86,6 +86,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         votes=s.votes & ~rs[:, None],
         next_index=jnp.where(rs[:, None], 1, s.next_index),
         match_index=jnp.where(rs[:, None], 0, s.match_index),
+        last_ack=jnp.where(rs[:, None], 0, s.last_ack),
         commit_index=jnp.where(rs, 0, s.commit_index),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
@@ -255,6 +256,12 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         a_succ, jnp.maximum(next_index, mb.resp_match + 1), next_index
     )
     next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
+    # Responsiveness stamps for the shared-window filter (phase 8): any AE response
+    # (success or failure) proves the peer is up; a fresh win grace-stamps every peer
+    # so the first window covers all of them.
+    now1 = s.now + 1
+    last_ack = jnp.where(win[:, None], now1, s.last_ack)
+    last_ack = jnp.where(aresp, now1, last_ack)
 
     # ---- phase 5: leader commit advancement (absent in reference, bug 2.3.8) ------
     is_leader = role == LEADER
@@ -319,13 +326,27 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # window per sender starting at the minimum peer prev (Mailbox docstring), so the
     # per-edge n_ent counts only the entries available to that peer within it.
     prev_out = jnp.clip(next_index - 1, 0, log_len[:, None])  # [src, dst]
-    ws = jnp.min(jnp.where(eye, cap, prev_out), axis=1)  # [src] shared window start
+    # Shared window start: minimum prev over RESPONSIVE peers (acked an AE within
+    # ack_timeout_ticks). A peer that never acks -- crashed, partitioned away -- must
+    # not pin the window, or no live follower could ever receive entries past
+    # ws + E and commit would stall despite a live quorum. When no peer is
+    # responsive (nothing to replicate to anyway) fall back to the min over all
+    # peers. An unresponsive laggard's prev is clamped UP to ws below: spec-safe
+    # (the consistency check at the too-high prev fails, it nacks, and that nack
+    # both re-admits it to the responsive set and walks next_index back down).
+    responsive = (now1 - last_ack) <= cfg.ack_timeout_ticks  # [src, dst]
+    big = cap + 1  # > any prev_out (prev_out <= log_len <= cap)
+    ws_resp = jnp.min(jnp.where(eye | ~responsive, big, prev_out), axis=1)  # [src]
+    ws_all = jnp.min(jnp.where(eye, big, prev_out), axis=1)
+    ws = jnp.where(ws_resp > cap, ws_all, ws_resp)
     ws = jnp.minimum(ws, log_len)
-    # Clamp each peer's prev into [ws, ws+E]: spec-safe (a peer ahead of the window
-    # gets a plain heartbeat over an older prefix it already has; its redundant ack
-    # is absorbed by the monotone max() updates of match/next in phase 4), and it
-    # bounds prev - ws to E+1 values so the batch-minor kernel can read prev terms
-    # from the shared window instead of a CAP-wide one-hot per edge.
+    # Clamp each peer's prev into [ws, ws+E]: spec-safe in both directions (a peer
+    # ahead of the window gets a plain heartbeat over an older prefix it already
+    # has, its redundant ack absorbed by the monotone max() updates of match/next
+    # in phase 4; an unresponsive laggard's prev is lifted to ws, its nack walks
+    # next_index back down and re-admits it to the responsive set), and it bounds
+    # prev - ws to E+1 values so the batch-minor kernel can read prev terms from
+    # the shared window instead of a CAP-wide one-hot per edge.
     prev_out = jnp.clip(prev_out, ws[:, None], (ws + e)[:, None])
     w_end = jnp.minimum(log_len, ws + e)  # [src] exclusive window end
     n_out = jnp.clip(w_end[:, None] - prev_out, 0, e)
@@ -375,6 +396,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         votes=votes,
         next_index=next_index,
         match_index=match_index,
+        last_ack=last_ack,
         commit_index=commit,
         log_term=log_term_arr,
         log_val=log_val_arr,

@@ -71,6 +71,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         votes=s.votes & ~rs2,
         next_index=jnp.where(rs2, 1, s.next_index),
         match_index=jnp.where(rs2, 0, s.match_index),
+        last_ack=jnp.where(rs2, 0, s.last_ack),
         commit_index=jnp.where(rs, 0, s.commit_index),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
@@ -207,6 +208,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     match_index = jnp.where(a_succ, jnp.maximum(match_index, mb.resp_match), match_index)
     next_index = jnp.where(a_succ, jnp.maximum(next_index, mb.resp_match + 1), next_index)
     next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
+    # Responsiveness stamps for the shared-window filter (phase 8; see raft.py).
+    now1 = s.now + 1  # [B]
+    last_ack = jnp.where(win[:, None, :], now1[None, None, :], s.last_ack)
+    last_ack = jnp.where(aresp, now1[None, None, :], last_ack)
 
     # ---- phase 5: leader commit advancement --------------------------------------
     is_leader = role == LEADER
@@ -263,7 +268,13 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_req_type = jnp.where(rv_edge, REQ_VOTE, jnp.where(ae_edge, REQ_APPEND, 0))
     out_req_term = jnp.broadcast_to(term[:, None, :], (n, n, b))
     prev_out = jnp.clip(next_index - 1, 0, log_len[:, None, :])  # [src, dst, B]
-    ws = jnp.min(jnp.where(eye3, cap, prev_out), axis=1)  # [N, B] shared window start
+    # Shared window start: minimum prev over RESPONSIVE peers, falling back to all
+    # peers when none are (see raft.py phase 8 for the liveness argument).
+    responsive = (now1[None, None, :] - last_ack) <= cfg.ack_timeout_ticks
+    big = cap + 1
+    ws_resp = jnp.min(jnp.where(eye3 | ~responsive, big, prev_out), axis=1)  # [N, B]
+    ws_all = jnp.min(jnp.where(eye3, big, prev_out), axis=1)
+    ws = jnp.where(ws_resp > cap, ws_all, ws_resp)
     ws = jnp.minimum(ws, log_len)
     # Clamp prev into [ws, ws+E] (see raft.py): prev - ws then has E+1 values, so
     # per-edge prev terms read from the E+1-slot extended window below instead of a
@@ -322,6 +333,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         votes=votes,
         next_index=next_index,
         match_index=match_index,
+        last_ack=last_ack,
         commit_index=commit,
         log_term=log_term_arr,
         log_val=log_val_arr,

@@ -68,8 +68,11 @@ class Mailbox(NamedTuple):
     Entry transport (TPU-native wire-format deviation from the reference, which ships
     an arbitrary per-peer log suffix, core.clj:59-67): a sender broadcasts ONE shared
     E-entry window of its log per tick -- `ent_term/ent_val` [N(src), E] starting at
-    1-based index `ent_start[src] + 1` -- positioned at the *minimum* prev-index among
-    its peers. Each receiver rebases into the shared window at offset
+    1-based index `ent_start[src] + 1` -- positioned at the minimum prev-index among
+    its RESPONSIVE peers (those that acked an AppendEntries within
+    config.ack_timeout_ticks, tracked in ClusterState.last_ack; falls back to all
+    peers when none are responsive, so a dead peer cannot pin the window start and
+    stall replication). Each receiver rebases into the shared window at offset
     (own prev_index - ent_start); the per-edge `req_n_ent` header already counts only
     the entries available to that receiver. Spec-equivalent (AppendEntries may carry
     any window the receiver validates against prev_index/prev_term) but the mailbox
@@ -115,6 +118,12 @@ class ClusterState(NamedTuple):
     votes: jax.Array  # [N, N] bool; votes[i, j] = i holds a granted vote from j
     next_index: jax.Array  # [N, N] int32; leader i's next index for peer j
     match_index: jax.Array  # [N, N] int32
+    # Tick at which leader i last received an AppendEntries response (success OR
+    # failure -- both prove the peer is up) from peer j; stamped to the current tick
+    # for the whole row when i wins an election (grace period). Volatile leader
+    # bookkeeping like next/match; drives the shared-entry-window responsiveness
+    # filter (config.ack_timeout_ticks).
+    last_ack: jax.Array  # [N, N] int32
     commit_index: jax.Array  # [N] int32
     log_term: jax.Array  # [N, CAP] int32
     log_val: jax.Array  # [N, CAP] int32
@@ -188,6 +197,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         votes=jnp.zeros((n, n), bool),
         next_index=jnp.ones((n, n), jnp.int32),
         match_index=jnp.zeros((n, n), jnp.int32),
+        last_ack=jnp.zeros((n, n), jnp.int32),
         commit_index=jnp.zeros((n,), jnp.int32),
         log_term=jnp.zeros((n, cap), jnp.int32),
         log_val=jnp.zeros((n, cap), jnp.int32),

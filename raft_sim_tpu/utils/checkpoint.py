@@ -30,7 +30,8 @@ from raft_sim_tpu.utils.config import RaftConfig
 # v3: RunMetrics gained total_cmds.
 # v4: Mailbox entry payload became the per-sender shared window (ent_start/term/val).
 # v5: req_* fields reoriented [sender, receiver], resp_* [receiver, responder].
-_FORMAT_VERSION = 5
+# v6: ClusterState gained last_ack (shared-window responsiveness stamps).
+_FORMAT_VERSION = 6
 
 
 def _normalize(path: str) -> str:

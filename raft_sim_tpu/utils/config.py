@@ -62,6 +62,17 @@ class RaftConfig:
     crash_period: int = 64
     crash_down_ticks: int = 12
 
+    # Shared-entry-window responsiveness horizon (ticks). A leader's AppendEntries
+    # entry payload is one shared E-entry window per tick (types.Mailbox); the window
+    # start is the minimum prev-index over peers that acked an AppendEntries within
+    # this many ticks (falling back to all peers when none have). Without the
+    # responsiveness filter a permanently dead peer pins the window start forever and
+    # live followers can never receive entries past window_start + E -- a liveness
+    # loss the reference cannot have (it ships unbounded per-peer suffixes,
+    # core.clj:59-67). Must comfortably exceed heartbeat_ticks + the 2-tick RPC round
+    # trip so a live peer is never spuriously excluded by ordinary heartbeat cadence.
+    ack_timeout_ticks: int = 12
+
     # Client command injection (reference: external curl POST /client-set,
     # server.clj:8-12, core.clj:151-160). Every `client_interval` ticks one command is
     # offered to each cluster's current leader; 0 disables.
@@ -78,6 +89,9 @@ class RaftConfig:
         assert self.heartbeat_ticks >= 1
         assert self.election_min_ticks > self.heartbeat_ticks
         assert self.election_range_ticks >= 1
+        # Needs real slack beyond heartbeat cadence + the 2-tick RPC round trip:
+        # at zero slack a single dropped ack transiently excludes every live peer.
+        assert self.ack_timeout_ticks >= self.heartbeat_ticks + 4
         if self.crash_prob > 0:
             assert self.crash_period >= 2
             assert 1 <= self.crash_down_ticks <= self.crash_period

@@ -56,7 +56,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     votes = s["votes"].copy()
     next_index = s["next_index"].copy()
     match_index = s["match_index"].copy()
+    last_ack = s["last_ack"].copy()
     commit = s["commit_index"].copy()
+    now1 = int(s["now"]) + 1
     log_term = s["log_term"].copy()
     log_val = s["log_val"].copy()
     log_len = s["log_len"].copy()
@@ -73,6 +75,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, :] = False
             next_index[d, :] = 1
             match_index[d, :] = 0
+            last_ack[d, :] = 0
             commit[d] = 0
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
 
@@ -215,6 +218,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             leader_id[d] = d
             next_index[d, :] = log_len[d] + 1
             match_index[d, :] = 0
+            last_ack[d, :] = now1  # grace-stamp every peer (see raft.py phase 4)
     for d in range(n):
         if role[d] != LEADER:
             continue
@@ -231,6 +235,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 next_index[d, src] = max(int(next_index[d, src]), m + 1)
             else:
                 next_index[d, src] = max(int(next_index[d, src]) - 1, 1)
+            # Any AE response (success or failure) proves the peer is up.
+            last_ack[d, src] = now1
 
     # ---- phase 5: leader commit advancement
     for d in range(n):
@@ -294,14 +300,19 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         last_idx = int(log_len[src])
         last_term = term_at(log_term[src], last_idx)
         if win[src] or heartbeat[src]:
-            # Shared entry window: starts at the minimum peer prev (Mailbox
-            # docstring); per-edge n_ent counts entries available to that peer.
-            prevs = [
-                min(max(int(next_index[src, dst]) - 1, 0), int(log_len[src]))
+            # Shared entry window: starts at the minimum prev over RESPONSIVE peers
+            # (acked an AE within ack_timeout_ticks), falling back to all peers when
+            # none are -- a dead peer must not pin the window (raft.py phase 8).
+            prev_of = lambda dst: min(
+                max(int(next_index[src, dst]) - 1, 0), int(log_len[src])
+            )
+            resp_prevs = [
+                prev_of(dst)
                 for dst in range(n)
-                if dst != src
+                if dst != src and now1 - int(last_ack[src, dst]) <= cfg.ack_timeout_ticks
             ]
-            ws = min(min(prevs), int(log_len[src]))
+            all_prevs = [prev_of(dst) for dst in range(n) if dst != src]
+            ws = min(min(resp_prevs or all_prevs), int(log_len[src]))
             w_end = min(int(log_len[src]), ws + e)
             out["ent_start"][src] = ws
             for k in range(w_end - ws):
@@ -350,6 +361,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "votes": votes,
         "next_index": next_index,
         "match_index": match_index,
+        "last_ack": last_ack,
         "commit_index": commit,
         "log_term": log_term,
         "log_val": log_val,
