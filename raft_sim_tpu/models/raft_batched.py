@@ -217,14 +217,26 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     is_leader = role == LEADER
     match_with_self = jnp.where(eye3, log_len[:, None, :], match_index)  # [N, N, B]
     # quorum-th largest match without a sort (TPU sorts along a non-minor axis are
-    # slow) and without the O(N^3) pairwise compare: match values are bounded by CAP,
-    # so count how many matches reach each threshold v in 1..CAP; cnt_ge is
-    # non-increasing in v, so the quorum-th order statistic is exactly the number of
-    # thresholds reached by >= quorum matches. O(N * CAP) compares per leader --
-    # 3x fewer ops than pairwise at N=51, and it shrinks with log capacity.
-    vth = iota((1, 1, cap, 1), 2) + 1  # thresholds 1..CAP
-    cnt_ge = jnp.sum(match_with_self[:, :, None, :] >= vth, axis=1)  # [N, CAP, B]
-    quorum_match = jnp.sum(cnt_ge >= cfg.quorum, axis=1).astype(jnp.int32)  # [N, B]
+    # slow). Two equivalent counting forms; pick per static shapes:
+    #   cap < n  (config5: N=51, CAP=16): match values are bounded by CAP, so count
+    #     how many matches reach each threshold v in 1..CAP; cnt_ge is non-increasing
+    #     in v, so the quorum-th order statistic is the number of thresholds reached
+    #     by >= quorum matches. O(N*CAP) compares per leader.
+    #   n <= cap (configs 1-4, CAP up to 2048): threshold over the N match values
+    #     themselves -- the quorum-th largest is the largest element v with
+    #     count(match >= v) >= quorum. O(N^2) compares per leader, independent of CAP
+    #     (the CAP-threshold form would do ~6x the work at N=5, CAP=32 and ~400x at
+    #     config1's CAP=2048).
+    if cap < n:
+        vth = iota((1, 1, cap, 1), 2) + 1  # thresholds 1..CAP
+        cnt_ge = jnp.sum(match_with_self[:, :, None, :] >= vth, axis=1)  # [N, CAP, B]
+        quorum_match = jnp.sum(cnt_ge >= cfg.quorum, axis=1).astype(jnp.int32)  # [N, B]
+    else:
+        ge = (
+            match_with_self[:, None, :, :] >= match_with_self[:, :, None, :]
+        )  # [N, j(candidate), k(counted), B]
+        ok = jnp.sum(ge, axis=2) >= cfg.quorum  # [N, N, B]
+        quorum_match = jnp.max(jnp.where(ok, match_with_self, 0), axis=1)  # [N, B]
     quorum_term = log_ops.term_at_b(log_term_arr, quorum_match)
     commit = jnp.where(
         is_leader & inp.alive & (quorum_match > commit) & (quorum_term == term),
