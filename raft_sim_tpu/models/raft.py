@@ -106,16 +106,20 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     dst_up = inp.alive & ~inp.restarted
     deliver_req = inp.deliver_mask.T & ~eye & inp.alive[:, None] & dst_up[None, :]
     deliver_resp = inp.deliver_mask & ~eye & dst_up[:, None] & inp.alive[None, :]
-    req_in = deliver_req & (mb.req_type != 0)  # [sender, receiver]
-    resp_in = deliver_resp & (mb.resp_type != 0)  # [receiver, responder]
+    req_in = deliver_req & (mb.req_type != 0)[:, None]  # [sender, receiver]
+    # Unpack the response word (Mailbox docstring: type | ok<<2 | match<<3).
+    r_type = mb.resp_word & 3
+    r_ok = (mb.resp_word >> 2) & 1
+    r_match = mb.resp_word >> 3
+    resp_in = deliver_resp & (r_type != 0)  # [receiver, responder]
 
     # ---- phase 1: term adoption --------------------------------------------------
     # Spec: any RPC (request or response) with term T > currentTerm -> set
     # currentTerm = T, convert to follower. The reference does this for responses
     # (core.clj:129-130, 144-145) but not vote requests (bug 2.3.2).
     in_term = jnp.maximum(
-        jnp.max(jnp.where(req_in, mb.req_term, 0), axis=0),
-        jnp.max(jnp.where(resp_in, mb.resp_term, 0), axis=1),
+        jnp.max(jnp.where(req_in, mb.req_term[:, None], 0), axis=0),
+        jnp.max(jnp.where(resp_in, mb.resp_term[None, :], 0), axis=1),
     )  # [N]
     saw_higher = in_term > s.term
     term = jnp.maximum(s.term, in_term)
@@ -127,13 +131,13 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     my_last_idx, my_last_term = log_ops.last_index_term(s.log_term, s.log_len)
 
     # ---- phase 2: RequestVote requests (request-vote-handler, core.clj:91-103) ----
-    is_rv = req_in & (mb.req_type == REQ_VOTE)  # [candidate, voter]
-    cur_rv = is_rv & (mb.req_term == term[None, :])  # stale-term requests are denied
+    is_rv = req_in & (mb.req_type == REQ_VOTE)[:, None]  # [candidate, voter]
+    cur_rv = is_rv & (mb.req_term[:, None] == term[None, :])  # stale terms are denied
     # Spec 5.4.1 up-to-date check (the reference's compare-prev? log.clj:55-59 compares
     # against the commit index and whole entry maps -- bugs 2.3.3/2.3.4).
-    up_to_date = (mb.req_prev_term > my_last_term[None, :]) | (
-        (mb.req_prev_term == my_last_term[None, :])
-        & (mb.req_prev_index >= my_last_idx[None, :])
+    up_to_date = (mb.req_last_term[:, None] > my_last_term[None, :]) | (
+        (mb.req_last_term[:, None] == my_last_term[None, :])
+        & (mb.req_last_index[:, None] >= my_last_idx[None, :])
     )
     can_grant = cur_rv & up_to_date
     # At most one grant per node per tick: the lowest eligible candidate id wins the
@@ -152,8 +156,8 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     vr_granted = grant
 
     # ---- phase 3: AppendEntries requests (append-entries-handler, core.clj:105-123) --
-    is_ae = req_in & (mb.req_type == REQ_APPEND)  # [leader, follower]
-    cur_ae = is_ae & (mb.req_term == term[None, :])
+    is_ae = req_in & (mb.req_type == REQ_APPEND)[:, None]  # [leader, follower]
+    cur_ae = is_ae & (mb.req_term[:, None] == term[None, :])
     # Election safety gives at most one leader per term, so at most one current-term AE
     # sender exists; pick the lowest id defensively (ties indicate a safety violation,
     # which phase 9 flags).
@@ -161,20 +165,23 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     has_ae = ae_src < n
     sel = cur_ae & (snd_ids == ae_src[None, :])  # one-hot [sender, receiver]
 
-    pick = lambda f: jnp.sum(jnp.where(sel, f, 0), axis=0)  # [N]
-    prev_i = pick(mb.req_prev_index)
-    prev_t = pick(mb.req_prev_term)
-    lcommit = pick(mb.req_commit)
-    n_ent = pick(mb.req_n_ent)
-    # Selected sender's SHARED entry window (src-indexed; Mailbox docstring), rebased
-    # at this receiver's own prev index: off = prev_i - ent_start[src] is in [0, E-1]
-    # whenever n_ent > 0; reads past the window only occur at masked (k >= n_ent)
-    # positions, where the clipped gather returns the last slot harmlessly.
+    # Reconstruct the per-edge AE header from the selected sender's broadcast record
+    # plus this edge's window offset j (Mailbox docstring). When no sender is
+    # selected everything is zeroed/garbage but gated by has_ae/ae_ok downstream.
+    j_in = jnp.sum(jnp.where(sel, mb.req_off, 0), axis=0)  # [N] in 0..E
     sel_idx = jnp.minimum(ae_src, n - 1)
+    ws_in = mb.ent_start[sel_idx]  # [N]
     w_term = mb.ent_term[sel_idx]  # [N, E]
     w_val = mb.ent_val[sel_idx]
-    ws_in = mb.ent_start[sel_idx]  # [N]
-    off = jnp.clip(prev_i - ws_in, 0, e - 1)
+    prev_i = jnp.where(has_ae, ws_in + j_in, 0)
+    lcommit = jnp.where(has_ae, mb.req_commit[sel_idx], 0)
+    n_ent = jnp.where(has_ae, jnp.clip(mb.ent_count[sel_idx] - j_in, 0, e), 0)
+    # prev term: the window slot just before this receiver's entries (j-1), or the
+    # sender's ent_prev_term for j == 0 -- ext[k] = term of 1-based entry ws+k.
+    ext = jnp.concatenate([mb.ent_prev_term[sel_idx][:, None], w_term], axis=1)
+    prev_t = jnp.take_along_axis(ext, j_in[:, None], axis=1)[:, 0]  # [N]
+    # This receiver's entries start at window slot j (slot k holds entry ws+k+1).
+    off = jnp.clip(j_in, 0, e - 1)  # j = E only when n_ent = 0 (fully masked)
     ent_term_in = log_ops.window(w_term, off, e)  # [N, E]
     ent_val_in = log_ops.window(w_val, off, e)
 
@@ -225,9 +232,12 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # ---- phase 4: responses ------------------------------------------------------
     # Vote tally (vote-response-handler core.clj:125-139; dedup via bitmap mirrors the
     # reference's set, core.clj:133-134).
-    vresp = resp_in & (mb.resp_type == RESP_VOTE)
+    vresp = resp_in & (r_type == RESP_VOTE)
     new_votes = (
-        vresp & mb.resp_ok & (mb.resp_term == term[:, None]) & (role == CANDIDATE)[:, None]
+        vresp
+        & (r_ok != 0)
+        & (mb.resp_term[None, :] == term[:, None])
+        & (role == CANDIDATE)[:, None]
     )
     votes = votes | new_votes
     n_votes = jnp.sum(votes, axis=1).astype(jnp.int32)
@@ -245,15 +255,15 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # log-index, bug 2.3.10); failure: decrement next-index and retry (core.clj:146).
     aresp = (
         resp_in
-        & (mb.resp_type == RESP_APPEND)
+        & (r_type == RESP_APPEND)
         & (role == LEADER)[:, None]
-        & (mb.resp_term == term[:, None])
+        & (mb.resp_term[None, :] == term[:, None])
     )
-    a_succ = aresp & mb.resp_ok
-    a_fail = aresp & ~mb.resp_ok
-    match_index = jnp.where(a_succ, jnp.maximum(match_index, mb.resp_match), match_index)
+    a_succ = aresp & (r_ok != 0)
+    a_fail = aresp & (r_ok == 0)
+    match_index = jnp.where(a_succ, jnp.maximum(match_index, r_match), match_index)
     next_index = jnp.where(
-        a_succ, jnp.maximum(next_index, mb.resp_match + 1), next_index
+        a_succ, jnp.maximum(next_index, r_match + 1), next_index
     )
     next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
     # Responsiveness stamps for the shared-window filter (phase 8): any AE response
@@ -316,15 +326,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     send_append = win | heartbeat  # fresh leaders heartbeat immediately (core.clj:137-138)
     new_last_idx, new_last_term = log_ops.last_index_term(log_term_arr, log_len)
 
-    # Requests are built [sender, receiver] -- exactly the mailbox orientation, so
-    # no transposes are needed anywhere in the outbox (Mailbox docstring).
-    rv_edge = start_election[:, None] & ~eye  # request-vote-rpc core.clj:48-54
-    ae_edge = send_append[:, None] & ~eye  # append-entries-rpc core.clj:56-67
-    out_req_type = jnp.where(rv_edge, REQ_VOTE, jnp.where(ae_edge, REQ_APPEND, 0))
-    out_req_term = jnp.broadcast_to(term[:, None], (n, n))
-    # AE headers: prev = nextIndex - 1 per edge; the entry payload is ONE shared
-    # window per sender starting at the minimum peer prev (Mailbox docstring), so the
-    # per-edge n_ent counts only the entries available to that peer within it.
+    # Request headers are PER SENDER -- both RPCs are broadcasts (request-vote-rpc
+    # core.clj:48-54, append-entries-rpc core.clj:56-67); the only per-edge request
+    # datum is the AE window offset (Mailbox docstring).
+    ae_edge = send_append[:, None] & ~eye
+    out_req_type = jnp.where(
+        start_election, REQ_VOTE, jnp.where(send_append, REQ_APPEND, 0)
+    )  # [N]
+    # AE: prev = nextIndex - 1 per edge, carried as the offset into the shared window.
     prev_out = jnp.clip(next_index - 1, 0, log_len[:, None])  # [src, dst]
     # Shared window start: minimum prev over RESPONSIVE peers (acked an AE within
     # ack_timeout_ticks). A peer that never acks -- crashed, partitioned away -- must
@@ -348,44 +357,39 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # prev - ws to E+1 values so the batch-minor kernel can read prev terms from
     # the shared window instead of a CAP-wide one-hot per edge.
     prev_out = jnp.clip(prev_out, ws[:, None], (ws + e)[:, None])
-    w_end = jnp.minimum(log_len, ws + e)  # [src] exclusive window end
-    n_out = jnp.clip(w_end[:, None] - prev_out, 0, e)
-    out_prev_term_ae = log_ops.term_at(log_term_arr, prev_out)
-    out_req_prev_index = jnp.where(rv_edge, new_last_idx[:, None], prev_out)
-    out_req_prev_term = jnp.where(rv_edge, new_last_term[:, None], out_prev_term_ae)
-    out_req_commit = jnp.broadcast_to(commit[:, None], (n, n))
-    out_req_n_ent = jnp.where(ae_edge, n_out, 0)
+    # Per-edge window offset j = prev - ws in 0..E; receivers reconstruct prev,
+    # prev_term, and n_entries from (j, ent_start, ent_prev_term, ent_count).
+    out_req_off = jnp.where(ae_edge, prev_out - ws[:, None], 0)
     # Zero unused window slots so the mailbox is canonical (receivers mask with
-    # n_ent anyway, but a canonical wire format keeps trajectories bit-comparable).
+    # the derived n_ent anyway, but a canonical wire format keeps trajectories
+    # bit-comparable).
     n_ship = jnp.clip(log_len - ws, 0, e)  # [src]
     ship_used = send_append[:, None] & (ks[None, :] < n_ship[:, None])  # [src, E]
-    out_ent_start = jnp.where(send_append, ws, 0)
     out_ent_term = jnp.where(ship_used, log_ops.window(log_term_arr, ws, e), 0)
     out_ent_val = jnp.where(ship_used, log_ops.window(log_val_arr, ws, e), 0)
 
     # Responses: vr_out/ar_out are [request-sender, request-receiver], which IS the
     # response orientation [response-receiver, responder] (the reference's resp-chan
-    # round trip, server.clj:59-60 -> client.clj:34-40); the responder's term rides
-    # along axis 1.
+    # round trip, server.clj:59-60 -> client.clj:34-40), packed into one word; the
+    # responder's term rides per responder (same value toward every requester).
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_term = jnp.broadcast_to(term[None, :], (n, n))
     out_resp_ok = vr_granted | ar_success
-    out_resp_match = ar_match
+    out_resp_word = out_resp_type + (out_resp_ok.astype(jnp.int32) << 2) + (ar_match << 3)
 
     new_mb = Mailbox(
         req_type=out_req_type,
-        req_term=jnp.where(out_req_type != 0, out_req_term, 0),
-        req_prev_index=jnp.where(out_req_type != 0, out_req_prev_index, 0),
-        req_prev_term=jnp.where(out_req_type != 0, out_req_prev_term, 0),
-        req_commit=jnp.where(ae_edge, out_req_commit, 0),
-        req_n_ent=out_req_n_ent,
-        ent_start=out_ent_start,
+        req_term=jnp.where(out_req_type != 0, term, 0),
+        req_commit=jnp.where(send_append, commit, 0),
+        req_last_index=jnp.where(start_election, new_last_idx, 0),
+        req_last_term=jnp.where(start_election, new_last_term, 0),
+        ent_start=jnp.where(send_append, ws, 0),
+        ent_prev_term=jnp.where(send_append, log_ops.term_at(log_term_arr, ws), 0),
+        ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
         ent_val=out_ent_val,
-        resp_type=out_resp_type,
-        resp_term=jnp.where(out_resp_type != 0, out_resp_term, 0),
-        resp_ok=out_resp_ok,
-        resp_match=out_resp_match,
+        req_off=out_req_off,
+        resp_word=out_resp_word,
+        resp_term=term,
     )
 
     new_state = ClusterState(

@@ -88,13 +88,17 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         & dst_up[None, :, :]
     )  # [N, N, B]
     deliver_resp = inp.deliver_mask & ~eye3 & dst_up[:, None, :] & inp.alive[None, :, :]
-    req_in = deliver_req & (mb.req_type != 0)
-    resp_in = deliver_resp & (mb.resp_type != 0)
+    req_in = deliver_req & (mb.req_type != 0)[:, None, :]
+    # Unpack the response word (Mailbox docstring: type | ok<<2 | match<<3).
+    r_type = mb.resp_word & 3
+    r_ok = (mb.resp_word >> 2) & 1
+    r_match = mb.resp_word >> 3
+    resp_in = deliver_resp & (r_type != 0)
 
     # ---- phase 1: term adoption --------------------------------------------------
     in_term = jnp.maximum(
-        jnp.max(jnp.where(req_in, mb.req_term, 0), axis=0),
-        jnp.max(jnp.where(resp_in, mb.resp_term, 0), axis=1),
+        jnp.max(jnp.where(req_in, mb.req_term[:, None, :], 0), axis=0),
+        jnp.max(jnp.where(resp_in, mb.resp_term[None, :, :], 0), axis=1),
     )  # [N, B]
     saw_higher = in_term > s.term
     term = jnp.maximum(s.term, in_term)
@@ -106,11 +110,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     my_last_idx, my_last_term = log_ops.last_index_term_b(s.log_term, s.log_len)
 
     # ---- phase 2: RequestVote requests -------------------------------------------
-    is_rv = req_in & (mb.req_type == REQ_VOTE)  # [candidate, voter, B]
-    cur_rv = is_rv & (mb.req_term == term[None, :, :])
-    up_to_date = (mb.req_prev_term > my_last_term[None, :, :]) | (
-        (mb.req_prev_term == my_last_term[None, :, :])
-        & (mb.req_prev_index >= my_last_idx[None, :, :])
+    is_rv = req_in & (mb.req_type == REQ_VOTE)[:, None, :]  # [candidate, voter, B]
+    cur_rv = is_rv & (mb.req_term[:, None, :] == term[None, :, :])
+    up_to_date = (mb.req_last_term[:, None, :] > my_last_term[None, :, :]) | (
+        (mb.req_last_term[:, None, :] == my_last_term[None, :, :])
+        & (mb.req_last_index[:, None, :] >= my_last_idx[None, :, :])
     )
     can_grant = cur_rv & up_to_date
     lowest = jnp.min(jnp.where(can_grant, snd_ids, n), axis=0)  # [N, B]
@@ -126,25 +130,33 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     vr_granted = grant
 
     # ---- phase 3: AppendEntries requests ------------------------------------------
-    is_ae = req_in & (mb.req_type == REQ_APPEND)  # [leader, follower, B]
-    cur_ae = is_ae & (mb.req_term == term[None, :, :])
+    is_ae = req_in & (mb.req_type == REQ_APPEND)[:, None, :]  # [leader, follower, B]
+    cur_ae = is_ae & (mb.req_term[:, None, :] == term[None, :, :])
     ae_src = jnp.min(jnp.where(cur_ae, snd_ids, n), axis=0)  # [N, B]
     has_ae = ae_src < n
     sel = cur_ae & (snd_ids == ae_src[None, :, :])  # one-hot [sender, receiver, B]
 
-    pick = lambda f: jnp.sum(jnp.where(sel, f, 0), axis=0)  # [N, B]
-    prev_i = pick(mb.req_prev_index)
-    prev_t = pick(mb.req_prev_term)
-    lcommit = pick(mb.req_commit)
-    n_ent = pick(mb.req_n_ent)
-    # Select the chosen sender's SHARED entry window + start via the same one-hot
-    # reduction (no gather; when no sender is selected everything is zeros, and every
-    # downstream use is masked by n_ent/ae_ok), then rebase into the receiver's own
-    # prev offset with a tiny E-wide shift (see raft.py / Mailbox docstring).
+    # Reconstruct the per-edge AE header from the selected sender's broadcast record
+    # plus this edge's window offset j (Mailbox docstring; raft.py phase 3). All
+    # selections are one-hot sums (no gather); when no sender is selected everything
+    # is zeros and gated by has_ae/ae_ok downstream.
+    pick_h = lambda h: jnp.sum(jnp.where(sel, h[:, None, :], 0), axis=0)  # [N, B]
+    j_in = jnp.sum(jnp.where(sel, mb.req_off, 0), axis=0)  # [N, B] in 0..E
+    ws_in = pick_h(mb.ent_start)
+    lcommit = pick_h(mb.req_commit)
+    prev_i = jnp.where(has_ae, ws_in + j_in, 0)
+    n_ent = jnp.where(has_ae, jnp.clip(pick_h(mb.ent_count) - j_in, 0, e), 0)
     w_term_in = jnp.sum(jnp.where(sel[:, :, None, :], mb.ent_term[:, None], 0), axis=0)  # [N, E, B]
     w_val_in = jnp.sum(jnp.where(sel[:, :, None, :], mb.ent_val[:, None], 0), axis=0)
-    ws_in = jnp.sum(jnp.where(sel, mb.ent_start[:, None], 0), axis=0)  # [N, B]
-    off = jnp.clip(prev_i - ws_in, 0, e - 1)
+    # prev term via ext[k] = term of 1-based entry ws+k: k=0 is the sender's
+    # ent_prev_term, k>=1 the shared window slots; one-hot over the E+1 offsets.
+    ext = jnp.concatenate(
+        [pick_h(mb.ent_prev_term)[:, None, :], w_term_in], axis=1
+    )  # [N, E+1, B]
+    oh_j = iota((1, e + 1, 1), 1) == j_in[:, None, :]
+    prev_t = jnp.sum(jnp.where(oh_j, ext, 0), axis=1)  # [N, B]
+    # This receiver's entries start at window slot j (slot k holds entry ws+k+1).
+    off = jnp.clip(j_in, 0, e - 1)  # j = E only when n_ent = 0 (fully masked)
     ent_term_in = log_ops.window_b(w_term_in, off, e)  # [N, E, B]
     ent_val_in = log_ops.window_b(w_val_in, off, e)
 
@@ -182,11 +194,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     ar_match = jnp.where(ar_success, last_new[None, :, :], 0)
 
     # ---- phase 4: responses ------------------------------------------------------
-    vresp = resp_in & (mb.resp_type == RESP_VOTE)
+    vresp = resp_in & (r_type == RESP_VOTE)
     new_votes = (
         vresp
-        & mb.resp_ok
-        & (mb.resp_term == term[:, None, :])
+        & (r_ok != 0)
+        & (mb.resp_term[None, :, :] == term[:, None, :])
         & (role == CANDIDATE)[:, None, :]
     )
     votes = votes | new_votes
@@ -199,14 +211,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
 
     aresp = (
         resp_in
-        & (mb.resp_type == RESP_APPEND)
+        & (r_type == RESP_APPEND)
         & (role == LEADER)[:, None, :]
-        & (mb.resp_term == term[:, None, :])
+        & (mb.resp_term[None, :, :] == term[:, None, :])
     )
-    a_succ = aresp & mb.resp_ok
-    a_fail = aresp & ~mb.resp_ok
-    match_index = jnp.where(a_succ, jnp.maximum(match_index, mb.resp_match), match_index)
-    next_index = jnp.where(a_succ, jnp.maximum(next_index, mb.resp_match + 1), next_index)
+    a_succ = aresp & (r_ok != 0)
+    a_fail = aresp & (r_ok == 0)
+    match_index = jnp.where(a_succ, jnp.maximum(match_index, r_match), match_index)
+    next_index = jnp.where(a_succ, jnp.maximum(next_index, r_match + 1), next_index)
     next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
     # Responsiveness stamps for the shared-window filter (phase 8; see raft.py).
     now1 = s.now + 1  # [B]
@@ -275,10 +287,12 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     send_append = win | heartbeat
     new_last_idx, new_last_term = log_ops.last_index_term_b(log_term_arr, log_len)
 
-    rv_edge = start_election[:, None, :] & ~eye3  # [src, dst, B]
+    # Request headers are per sender (both RPCs are broadcasts); only the AE window
+    # offset is per edge (Mailbox docstring; raft.py phase 8).
     ae_edge = send_append[:, None, :] & ~eye3
-    out_req_type = jnp.where(rv_edge, REQ_VOTE, jnp.where(ae_edge, REQ_APPEND, 0))
-    out_req_term = jnp.broadcast_to(term[:, None, :], (n, n, b))
+    out_req_type = jnp.where(
+        start_election, REQ_VOTE, jnp.where(send_append, REQ_APPEND, 0)
+    )  # [N, B]
     prev_out = jnp.clip(next_index - 1, 0, log_len[:, None, :])  # [src, dst, B]
     # Shared window start: minimum prev over RESPONSIVE peers, falling back to all
     # peers when none are (see raft.py phase 8 for the liveness argument).
@@ -288,53 +302,40 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     ws_all = jnp.min(jnp.where(eye3, big, prev_out), axis=1)
     ws = jnp.where(ws_resp > cap, ws_all, ws_resp)
     ws = jnp.minimum(ws, log_len)
-    # Clamp prev into [ws, ws+E] (see raft.py): prev - ws then has E+1 values, so
-    # per-edge prev terms read from the E+1-slot extended window below instead of a
-    # CAP-wide one-hot per edge (that one-hot was ~26% of the N=51 tick).
+    # Clamp prev into [ws, ws+E] (see raft.py): the per-edge request payload then
+    # reduces to the offset j = prev - ws in 0..E; receivers reconstruct prev,
+    # prev_term, and n_entries from it and the per-sender header.
     prev_out = jnp.clip(prev_out, ws[:, None, :], (ws + e)[:, None, :])
-    w_end = jnp.minimum(log_len, ws + e)  # [N, B]
-    n_out = jnp.clip(w_end[:, None, :] - prev_out, 0, e)
+    out_req_off = jnp.where(ae_edge, prev_out - ws[:, None, :], 0)
     wt = log_ops.window_b(log_term_arr, ws, e)  # [N, E, B] shared window terms
     wv = log_ops.window_b(log_val_arr, ws, e)
-    # ext[s, j] = term of 1-based index ws+j, j in 0..E: j=0 is one [N, B] term_at;
-    # j>=1 are exactly the shared window slots (prev' <= log_len keeps them valid).
-    ext = jnp.concatenate(
-        [log_ops.term_at_b(log_term_arr, ws)[:, None, :], wt], axis=1
-    )  # [N, E+1, B]
-    oh_j = iota((1, 1, e + 1, 1), 2) == (prev_out - ws[:, None, :])[:, :, None, :]
-    out_prev_term_ae = jnp.sum(jnp.where(oh_j, ext[:, None], 0), axis=2)  # [N, N, B]
-    out_req_prev_index = jnp.where(rv_edge, new_last_idx[:, None, :], prev_out)
-    out_req_prev_term = jnp.where(rv_edge, new_last_term[:, None, :], out_prev_term_ae)
-    out_req_commit = jnp.broadcast_to(commit[:, None, :], (n, n, b))
-    out_req_n_ent = jnp.where(ae_edge, n_out, 0)
     n_ship = jnp.clip(log_len - ws, 0, e)  # [N, B]
     ship_used = send_append[:, None, :] & (iota((1, e, 1), 1) < n_ship[:, None, :])
-    out_ent_start = jnp.where(send_append, ws, 0)
     out_ent_term = jnp.where(ship_used, wt, 0)
     out_ent_val = jnp.where(ship_used, wv, 0)
 
-    # Requests are [sender, receiver] and responses [receiver, responder] -- both
-    # exactly the mailbox orientation, so the outbox is transpose-free (the per-tick
-    # transposes of ten [N, N, B] fields this replaces were ~15% of the N=51 tick).
+    # Responses [receiver, responder] pack into one word; the responder's term is a
+    # per-responder field (same value toward every requester). The outbox is
+    # transpose-free and now also broadcast-free: nothing [N, N]-shaped is written
+    # beyond the offset and response planes.
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_term = jnp.broadcast_to(term[None, :, :], (n, n, b))
-    out_resp_ok = vr_granted | ar_success
-    out_resp_match = ar_match
+    out_resp_ok = (vr_granted | ar_success).astype(jnp.int32)
+    out_resp_word = out_resp_type + (out_resp_ok << 2) + (ar_match << 3)
 
     new_mb = Mailbox(
         req_type=out_req_type,
-        req_term=jnp.where(out_req_type != 0, out_req_term, 0),
-        req_prev_index=jnp.where(out_req_type != 0, out_req_prev_index, 0),
-        req_prev_term=jnp.where(out_req_type != 0, out_req_prev_term, 0),
-        req_commit=jnp.where(ae_edge, out_req_commit, 0),
-        req_n_ent=out_req_n_ent,
-        ent_start=out_ent_start,
+        req_term=jnp.where(out_req_type != 0, term, 0),
+        req_commit=jnp.where(send_append, commit, 0),
+        req_last_index=jnp.where(start_election, new_last_idx, 0),
+        req_last_term=jnp.where(start_election, new_last_term, 0),
+        ent_start=jnp.where(send_append, ws, 0),
+        ent_prev_term=jnp.where(send_append, log_ops.term_at_b(log_term_arr, ws), 0),
+        ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
         ent_val=out_ent_val,
-        resp_type=out_resp_type,
-        resp_term=jnp.where(out_resp_type != 0, out_resp_term, 0),
-        resp_ok=out_resp_ok,
-        resp_match=out_resp_match,
+        req_off=out_req_off,
+        resp_word=out_resp_word,
+        resp_term=term,
     )
 
     new_state = ClusterState(

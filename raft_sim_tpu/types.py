@@ -47,56 +47,59 @@ NIL = -1  # nil node id
 
 
 class Mailbox(NamedTuple):
-    """One in-flight RPC slot per directed edge. Index orientation is chosen so that
-    every outbox write is transpose-free (transposing ten [N, N, batch] fields per
-    tick was ~15% of the N=51 tick):
+    """In-flight RPC state, one tick deep. TPU-native wire format, v7.
 
-      req_*  fields: [sender, receiver]   -- a sender broadcasts along its row;
-                                             receivers reduce over axis 0.
-      resp_* fields: [receiver, responder] -- a responder answers the request slot
-                                             [q, r] it was addressed in, so the
-                                             response to q lands at [q, r] directly;
-                                             requesters reduce over axis 1.
+    Both RPCs are logically broadcasts (the reference sends RequestVote and
+    AppendEntries to every peer, core.clj:48-67), and after the shared-window prev
+    clamp the only genuinely per-edge request datum is a tiny window offset. So the
+    wire format carries request HEADERS per sender ([N] -- one record broadcast to
+    all peers) and only two per-edge planes, cutting the [N, N]-shaped mailbox
+    traffic from ten int32 fields to two (the mailbox was the dominant HBM traffic
+    of the N=51 tick: ~5x the logical state bytes):
 
-    The AppendEntries entry payload is *shared per sender* (src-indexed).
+      req_* / ent_* headers: [N(sender)] -- receivers reduce senders over axis 0
+        after outer-producting with the per-edge delivery mask.
+      req_off:  [sender, receiver] -- AppendEntries per-edge window offset j.
+      resp_word: [receiver, responder] -- packed response; the response to
+        requester q from responder r lands at [q, r] directly, requesters reduce
+        over axis 1.
 
-    Request header fields overlay both message types (reference wire formats
-    core.clj:51-54 and core.clj:62-67):
-      REQ_VOTE:   prev_index = last-log-index, prev_term = last-log-term
-      REQ_APPEND: prev_index/prev_term/commit/n_ent as named
+    AppendEntries reconstruction at receiver d from sender s (validated against the
+    usual prev checks, so spec-equivalent to an explicit per-edge header):
+      prev_index = ent_start[s] + req_off[s, d]          (j = req_off in 0..E)
+      prev_term  = ent_prev_term[s] if j == 0 else ent_term[s, j-1]
+      n_entries  = clip(ent_count[s] - j, 0, E)
+      entries    = ent_term/ent_val[s, j :]              (window slot k holds the
+                                                          1-based entry ent_start+k+1)
+      leaderCommit = req_commit[s]
+    The shared E-entry window (reference ships arbitrary per-peer suffixes,
+    core.clj:59-67) starts at the minimum prev-index among RESPONSIVE peers (acked
+    an AppendEntries within config.ack_timeout_ticks, ClusterState.last_ack; falls
+    back to all peers when none are responsive, so a dead peer cannot pin the
+    window start and stall replication); each peer's prev is clamped into
+    [ent_start, ent_start + E], which is what makes j fit 0..E.
 
-    Entry transport (TPU-native wire-format deviation from the reference, which ships
-    an arbitrary per-peer log suffix, core.clj:59-67): a sender broadcasts ONE shared
-    E-entry window of its log per tick -- `ent_term/ent_val` [N(src), E] starting at
-    1-based index `ent_start[src] + 1` -- positioned at the minimum prev-index among
-    its RESPONSIVE peers (those that acked an AppendEntries within
-    config.ack_timeout_ticks, tracked in ClusterState.last_ack; falls back to all
-    peers when none are responsive, so a dead peer cannot pin the window start and
-    stall replication). Each receiver rebases into the shared window at offset
-    (own prev_index - ent_start); the per-edge `req_n_ent` header already counts only
-    the entries available to that receiver. Spec-equivalent (AppendEntries may carry
-    any window the receiver validates against prev_index/prev_term) but the mailbox
-    payload is O(N*E) instead of O(N^2*E) -- at N=51 the per-edge form was ~70% of all
-    mailbox bytes and the dominant HBM traffic of the whole tick.
-
-    Response fields overlay :vote-response {term,vote-granted} (core.clj:95-102) and
-    :append-response {term,success,log-index} (core.clj:109-121): `ok` is
-    granted/success, `match` is the acknowledged log index for successful appends.
+    Responses overlay :vote-response {term,vote-granted} (core.clj:95-102) and
+    :append-response {term,success,log-index} (core.clj:109-121) in one packed
+    word: type (2 bits) | ok << 2 | match << 3, where `ok` is granted/success and
+    `match` the acknowledged log index of a successful append. The responder's
+    term rides per responder in resp_term (every requester sees the same value --
+    it is the responder's term at send time).
     """
 
-    req_type: jax.Array  # [N(sender), N(receiver)] int32 (REQ_*)
-    req_term: jax.Array  # [sender, receiver] int32
-    req_prev_index: jax.Array  # [sender, receiver] int32
-    req_prev_term: jax.Array  # [sender, receiver] int32
-    req_commit: jax.Array  # [sender, receiver] int32
-    req_n_ent: jax.Array  # [sender, receiver] int32
+    req_type: jax.Array  # [N(sender)] int32 (REQ_*): this tick's broadcast, if any
+    req_term: jax.Array  # [N] int32: sender's term at send time
+    req_commit: jax.Array  # [N] int32: AE leaderCommit
+    req_last_index: jax.Array  # [N] int32: RV lastLogIndex
+    req_last_term: jax.Array  # [N] int32: RV lastLogTerm
     ent_start: jax.Array  # [N] int32: 0-based slot where src's shared window starts
+    ent_prev_term: jax.Array  # [N] int32: term of the 1-based entry ent_start (j=0 prev)
+    ent_count: jax.Array  # [N] int32: entries shipped = min(log_len - ent_start, E)
     ent_term: jax.Array  # [N, E] int32: src's shared entry window (terms)
     ent_val: jax.Array  # [N, E] int32: src's shared entry window (values)
-    resp_type: jax.Array  # [N(receiver), N(responder)] int32 (RESP_*)
-    resp_term: jax.Array  # [receiver, responder] int32
-    resp_ok: jax.Array  # [receiver, responder] bool
-    resp_match: jax.Array  # [receiver, responder] int32
+    req_off: jax.Array  # [N(sender), N(receiver)] int32: AE window offset j in 0..E
+    resp_word: jax.Array  # [N(receiver), N(responder)] int32: type | ok<<2 | match<<3
+    resp_term: jax.Array  # [N(responder)] int32: responder's term at send time
 
 
 class ClusterState(NamedTuple):
@@ -167,19 +170,19 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
     n, e = cfg.n_nodes, cfg.max_entries_per_rpc
     i = lambda *s: jnp.zeros(s, jnp.int32)
     return Mailbox(
-        req_type=i(n, n),
-        req_term=i(n, n),
-        req_prev_index=i(n, n),
-        req_prev_term=i(n, n),
-        req_commit=i(n, n),
-        req_n_ent=i(n, n),
+        req_type=i(n),
+        req_term=i(n),
+        req_commit=i(n),
+        req_last_index=i(n),
+        req_last_term=i(n),
         ent_start=i(n),
+        ent_prev_term=i(n),
+        ent_count=i(n),
         ent_term=i(n, e),
         ent_val=i(n, e),
-        resp_type=i(n, n),
-        resp_term=i(n, n),
-        resp_ok=jnp.zeros((n, n), bool),
-        resp_match=i(n, n),
+        req_off=i(n, n),
+        resp_word=i(n, n),
+        resp_term=i(n),
     )
 
 

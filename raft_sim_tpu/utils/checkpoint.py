@@ -31,7 +31,10 @@ from raft_sim_tpu.utils.config import RaftConfig
 # v4: Mailbox entry payload became the per-sender shared window (ent_start/term/val).
 # v5: req_* fields reoriented [sender, receiver], resp_* [receiver, responder].
 # v6: ClusterState gained last_ack (shared-window responsiveness stamps).
-_FORMAT_VERSION = 6
+# v7: mailbox wire format v7 -- per-sender request headers (req_type/term/commit,
+#     RV last_index/last_term, AE window start/prev-term/count) + per-edge window
+#     offsets (req_off) and packed response words (resp_word, per-responder term).
+_FORMAT_VERSION = 7
 
 
 def _normalize(path: str) -> str:

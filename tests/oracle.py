@@ -80,14 +80,18 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
 
     # ---- phase 0: delivery
-    # Input mask is per physical edge [to, from]; request fields are [sender,
-    # receiver] (mask transposed), response fields [receiver, responder] (direct).
+    # Input mask is per physical edge [to, from]; request headers are per sender
+    # (broadcasts; the [sender, receiver] masks read the edge mask transposed),
+    # responses are [receiver, responder] packed words (direct).
     # A receiver must be alive now AND at send time (last tick): alive & ~restarted.
     edge_ok = np.asarray(inp["deliver_mask"], bool).copy()
     np.fill_diagonal(edge_ok, False)
     recv_up = alive & ~restarted
-    req_in = edge_ok.T & alive[:, None] & recv_up[None, :] & (mb["req_type"] != 0)
-    resp_in = edge_ok & recv_up[:, None] & alive[None, :] & (mb["resp_type"] != 0)
+    req_in = edge_ok.T & alive[:, None] & recv_up[None, :] & (mb["req_type"] != 0)[:, None]
+    r_type = mb["resp_word"] & 3
+    r_ok = (mb["resp_word"] >> 2) & 1
+    r_match = mb["resp_word"] >> 3
+    resp_in = edge_ok & recv_up[:, None] & alive[None, :] & (r_type != 0)
 
     # ---- phase 1: term adoption
     saw_higher = np.zeros(n, bool)
@@ -95,9 +99,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         in_term = 0
         for src in range(n):
             if req_in[src, d]:
-                in_term = max(in_term, int(mb["req_term"][src, d]))
+                in_term = max(in_term, int(mb["req_term"][src]))
             if resp_in[d, src]:
-                in_term = max(in_term, int(mb["resp_term"][d, src]))
+                in_term = max(in_term, int(mb["resp_term"][src]))
         if in_term > term[d]:
             saw_higher[d] = True
             term[d] = in_term
@@ -115,13 +119,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         my_last_term = term_at(s["log_term"][d], my_last_idx)
         can = []
         for src in range(n):
-            if not (req_in[src, d] and mb["req_type"][src, d] == REQ_VOTE):
+            if not (req_in[src, d] and mb["req_type"][src] == REQ_VOTE):
                 continue
             vr_out[d, src] = True
-            if mb["req_term"][src, d] != term[d]:
+            if mb["req_term"][src] != term[d]:
                 continue
-            c_idx = int(mb["req_prev_index"][src, d])
-            c_term = int(mb["req_prev_term"][src, d])
+            c_idx = int(mb["req_last_index"][src])
+            c_term = int(mb["req_last_term"][src])
             up_to_date = c_term > my_last_term or (
                 c_term == my_last_term and c_idx >= my_last_idx
             )
@@ -149,11 +153,11 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             src
             for src in range(n)
             if req_in[src, d]
-            and mb["req_type"][src, d] == REQ_APPEND
+            and mb["req_type"][src] == REQ_APPEND
         ]
         for src in cur:
             ar_out[d, src] = True
-        cur_term = [src for src in cur if mb["req_term"][src, d] == term[d]]
+        cur_term = [src for src in cur if mb["req_term"][src] == term[d]]
         if not cur_term:
             continue
         src = min(cur_term)
@@ -162,15 +166,22 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             role[d] = FOLLOWER
         leader_id[d] = src
 
-        prev_i = int(mb["req_prev_index"][src, d])
-        prev_t = int(mb["req_prev_term"][src, d])
-        lcommit = int(mb["req_commit"][src, d])
-        n_ent = int(mb["req_n_ent"][src, d])
-        # Rebase the sender's shared window at this receiver's prev index (clipped
-        # reads past the window occur only at masked k >= n_ent positions).
-        off = int(prev_i) - int(mb["ent_start"][src])
-        ent_t = [int(mb["ent_term"][src, min(max(off, 0) + k, e - 1)]) for k in range(e)]
-        ent_v = [int(mb["ent_val"][src, min(max(off, 0) + k, e - 1)]) for k in range(e)]
+        # Reconstruct the per-edge AE header from the sender's broadcast record plus
+        # this edge's window offset j (Mailbox docstring): prev = ent_start + j,
+        # prev term = ent_prev_term for j == 0 else window slot j-1, and n_entries =
+        # whatever of the window lies past j.
+        j = int(mb["req_off"][src, d])
+        ws = int(mb["ent_start"][src])
+        prev_i = ws + j
+        prev_t = (
+            int(mb["ent_prev_term"][src]) if j == 0 else int(mb["ent_term"][src, j - 1])
+        )
+        lcommit = int(mb["req_commit"][src])
+        n_ent = min(max(int(mb["ent_count"][src]) - j, 0), e)
+        # This receiver's entries start at window slot j (clipped reads past the
+        # window occur only at masked k >= n_ent positions).
+        ent_t = [int(mb["ent_term"][src, min(j + k, e - 1)]) for k in range(e)]
+        ent_v = [int(mb["ent_val"][src, min(j + k, e - 1)]) for k in range(e)]
 
         consistent = prev_i == 0 or (
             prev_i <= int(s["log_len"][d])
@@ -204,9 +215,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for src in range(n):
             if (
                 resp_in[d, src]
-                and mb["resp_type"][d, src] == RESP_VOTE
-                and mb["resp_ok"][d, src]
-                and mb["resp_term"][d, src] == term[d]
+                and r_type[d, src] == RESP_VOTE
+                and r_ok[d, src]
+                and mb["resp_term"][src] == term[d]
                 and role[d] == CANDIDATE
             ):
                 votes[d, src] = True
@@ -225,12 +236,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for src in range(n):
             if not (
                 resp_in[d, src]
-                and mb["resp_type"][d, src] == RESP_APPEND
-                and mb["resp_term"][d, src] == term[d]
+                and r_type[d, src] == RESP_APPEND
+                and mb["resp_term"][src] == term[d]
             ):
                 continue
-            if mb["resp_ok"][d, src]:
-                m = int(mb["resp_match"][d, src])
+            if r_ok[d, src]:
+                m = int(r_match[d, src])
                 match_index[d, src] = max(int(match_index[d, src]), m)
                 next_index[d, src] = max(int(next_index[d, src]), m + 1)
             else:
@@ -279,27 +290,32 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, d] = True
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
 
-    # ---- phase 8: outbox
+    # ---- phase 8: outbox (wire format v7: per-sender headers + per-edge offsets)
     z = lambda *shape: np.zeros(shape, np.int32)
     out = {
-        "req_type": z(n, n),
-        "req_term": z(n, n),
-        "req_prev_index": z(n, n),
-        "req_prev_term": z(n, n),
-        "req_commit": z(n, n),
-        "req_n_ent": z(n, n),
+        "req_type": z(n),
+        "req_term": z(n),
+        "req_commit": z(n),
+        "req_last_index": z(n),
+        "req_last_term": z(n),
         "ent_start": z(n),
+        "ent_prev_term": z(n),
+        "ent_count": z(n),
         "ent_term": z(n, e),
         "ent_val": z(n, e),
-        "resp_type": z(n, n),
-        "resp_term": z(n, n),
-        "resp_ok": np.zeros((n, n), bool),
-        "resp_match": z(n, n),
+        "req_off": z(n, n),
+        "resp_word": z(n, n),
+        "resp_term": z(n),
     }
     for src in range(n):
-        last_idx = int(log_len[src])
-        last_term = term_at(log_term[src], last_idx)
-        if win[src] or heartbeat[src]:
+        out["resp_term"][src] = term[src]
+        if start_election[src]:
+            last_idx = int(log_len[src])
+            out["req_type"][src] = REQ_VOTE
+            out["req_term"][src] = term[src]
+            out["req_last_index"][src] = last_idx
+            out["req_last_term"][src] = term_at(log_term[src], last_idx)
+        elif win[src] or heartbeat[src]:
             # Shared entry window: starts at the minimum prev over RESPONSIVE peers
             # (acked an AE within ack_timeout_ticks), falling back to all peers when
             # none are -- a dead peer must not pin the window (raft.py phase 8).
@@ -313,32 +329,23 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             ]
             all_prevs = [prev_of(dst) for dst in range(n) if dst != src]
             ws = min(min(resp_prevs or all_prevs), int(log_len[src]))
-            w_end = min(int(log_len[src]), ws + e)
+            n_ship = min(int(log_len[src]) - ws, e)
+            out["req_type"][src] = REQ_APPEND
+            out["req_term"][src] = term[src]
+            out["req_commit"][src] = commit[src]
             out["ent_start"][src] = ws
-            for k in range(w_end - ws):
+            out["ent_prev_term"][src] = term_at(log_term[src], ws)
+            out["ent_count"][src] = n_ship
+            for k in range(n_ship):
                 out["ent_term"][src, k] = log_term[src, ws + k]
                 out["ent_val"][src, k] = log_val[src, ws + k]
-        for dst in range(n):
-            if dst == src:
-                continue
-            if start_election[src]:
-                out["req_type"][src, dst] = REQ_VOTE
-                out["req_term"][src, dst] = term[src]
-                out["req_prev_index"][src, dst] = last_idx
-                out["req_prev_term"][src, dst] = last_term
-            elif win[src] or heartbeat[src]:
-                prev = min(max(int(next_index[src, dst]) - 1, 0), int(log_len[src]))
-                # Clamp into [ws, ws+E] to match the kernel: a peer ahead of the
-                # shared window gets a heartbeat over an older prefix (spec-safe;
-                # its redundant ack is absorbed by the monotone match/next max).
-                prev = min(max(prev, ws), ws + e)
-                cnt = min(max(w_end - prev, 0), e)
-                out["req_type"][src, dst] = REQ_APPEND
-                out["req_term"][src, dst] = term[src]
-                out["req_prev_index"][src, dst] = prev
-                out["req_prev_term"][src, dst] = term_at(log_term[src], prev)
-                out["req_commit"][src, dst] = commit[src]
-                out["req_n_ent"][src, dst] = cnt
+            for dst in range(n):
+                if dst == src:
+                    continue
+                # Per-edge offset j = prev - ws, with prev clamped into [ws, ws+E]
+                # (a peer ahead of the window gets a heartbeat over an older prefix;
+                # an unresponsive laggard's prev is lifted to the window start).
+                out["req_off"][src, dst] = min(max(prev_of(dst), ws), ws + e) - ws
     # Responses travel back src<->dst: responder r answers requester q.
     for r in range(n):
         for q in range(n):
@@ -348,10 +355,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             if ar_out[r, q]:
                 rtype += RESP_APPEND
             if rtype:
-                out["resp_type"][q, r] = rtype
-                out["resp_term"][q, r] = term[r]
-                out["resp_ok"][q, r] = bool(vr_granted[r, q] or ar_success[r, q])
-                out["resp_match"][q, r] = ar_match[r, q]
+                ok = int(bool(vr_granted[r, q] or ar_success[r, q]))
+                out["resp_word"][q, r] = rtype + (ok << 2) + (int(ar_match[r, q]) << 3)
 
     return {
         "role": role,
