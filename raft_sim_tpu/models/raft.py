@@ -49,6 +49,7 @@ import jax.numpy as jnp
 
 from raft_sim_tpu.ops import log_ops
 from raft_sim_tpu.types import (
+    ACK_AGE_SAT,
     CANDIDATE,
     FOLLOWER,
     LEADER,
@@ -86,7 +87,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         votes=s.votes & ~rs[:, None],
         next_index=jnp.where(rs[:, None], 1, s.next_index),
         match_index=jnp.where(rs[:, None], 0, s.match_index),
-        last_ack=jnp.where(rs[:, None], 0, s.last_ack),
+        ack_age=jnp.where(rs[:, None], ACK_AGE_SAT, s.ack_age),
         commit_index=jnp.where(rs, 0, s.commit_index),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
@@ -168,7 +169,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # Reconstruct the per-edge AE header from the selected sender's broadcast record
     # plus this edge's window offset j (Mailbox docstring). When no sender is
     # selected everything is zeroed/garbage but gated by has_ae/ae_ok downstream.
-    j_in = jnp.sum(jnp.where(sel, mb.req_off, 0), axis=0)  # [N] in 0..E
+    j_in = jnp.sum(jnp.where(sel, mb.req_off, 0), axis=0).astype(jnp.int32)  # [N] in 0..E
     sel_idx = jnp.minimum(ae_src, n - 1)
     ws_in = mb.ent_start[sel_idx]  # [N]
     w_term = mb.ent_term[sel_idx]  # [N, E]
@@ -247,7 +248,8 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     leader_id = jnp.where(win, ids, leader_id)
     # Fresh leader bookkeeping (leader-state core.clj:40-42): nextIndex = last log
     # index + 1, matchIndex = 0.
-    next_index = jnp.where(win[:, None], (log_len + 1)[:, None], s.next_index)
+    len16 = log_len.astype(jnp.int16)  # indices fit int16 (config caps log_capacity)
+    next_index = jnp.where(win[:, None], (len16 + 1)[:, None], s.next_index)
     match_index = jnp.where(win[:, None], 0, s.match_index)
 
     # Append responses (append-response-handler core.clj:141-149), leaders only, same
@@ -266,12 +268,12 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         a_succ, jnp.maximum(next_index, r_match + 1), next_index
     )
     next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
-    # Responsiveness stamps for the shared-window filter (phase 8): any AE response
-    # (success or failure) proves the peer is up; a fresh win grace-stamps every peer
-    # so the first window covers all of them.
-    now1 = s.now + 1
-    last_ack = jnp.where(win[:, None], now1, s.last_ack)
-    last_ack = jnp.where(aresp, now1, last_ack)
+    # Responsiveness ages for the shared-window filter (phase 8): everyone ages one
+    # tick (saturating); any AE response (success or failure) proves the peer is up
+    # and zeroes its age, and a fresh win grace-zeroes every peer so the first
+    # window covers all of them.
+    ack_age = jnp.minimum(s.ack_age + 1, ACK_AGE_SAT)
+    ack_age = jnp.where(win[:, None] | aresp, 0, ack_age)
 
     # ---- phase 5: leader commit advancement (absent in reference, bug 2.3.8) ------
     is_leader = role == LEADER
@@ -343,7 +345,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # peers. An unresponsive laggard's prev is clamped UP to ws below: spec-safe
     # (the consistency check at the too-high prev fails, it nacks, and that nack
     # both re-admits it to the responsive set and walks next_index back down).
-    responsive = (now1 - last_ack) <= cfg.ack_timeout_ticks  # [src, dst]
+    responsive = ack_age <= cfg.ack_timeout_ticks  # [src, dst]
     big = cap + 1  # > any prev_out (prev_out <= log_len <= cap)
     ws_resp = jnp.min(jnp.where(eye | ~responsive, big, prev_out), axis=1)  # [src]
     ws_all = jnp.min(jnp.where(eye, big, prev_out), axis=1)
@@ -359,7 +361,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     prev_out = jnp.clip(prev_out, ws[:, None], (ws + e)[:, None])
     # Per-edge window offset j = prev - ws in 0..E; receivers reconstruct prev,
     # prev_term, and n_entries from (j, ent_start, ent_prev_term, ent_count).
-    out_req_off = jnp.where(ae_edge, prev_out - ws[:, None], 0)
+    out_req_off = jnp.where(ae_edge, prev_out - ws[:, None], 0).astype(jnp.int8)
     # Zero unused window slots so the mailbox is canonical (receivers mask with
     # the derived n_ent anyway, but a canonical wire format keeps trajectories
     # bit-comparable).
@@ -374,7 +376,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # responder's term rides per responder (same value toward every requester).
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
     out_resp_ok = vr_granted | ar_success
-    out_resp_word = out_resp_type + (out_resp_ok.astype(jnp.int32) << 2) + (ar_match << 3)
+    out_resp_word = (
+        out_resp_type + (out_resp_ok.astype(jnp.int32) << 2) + (ar_match << 3)
+    ).astype(jnp.int16)
 
     new_mb = Mailbox(
         req_type=out_req_type,
@@ -400,7 +404,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         votes=votes,
         next_index=next_index,
         match_index=match_index,
-        last_ack=last_ack,
+        ack_age=ack_age,
         commit_index=commit,
         log_term=log_term_arr,
         log_val=log_val_arr,

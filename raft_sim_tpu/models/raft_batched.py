@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from raft_sim_tpu.ops import log_ops
 from raft_sim_tpu.types import (
+    ACK_AGE_SAT,
     CANDIDATE,
     FOLLOWER,
     LEADER,
@@ -71,7 +72,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         votes=s.votes & ~rs2,
         next_index=jnp.where(rs2, 1, s.next_index),
         match_index=jnp.where(rs2, 0, s.match_index),
-        last_ack=jnp.where(rs2, 0, s.last_ack),
+        ack_age=jnp.where(rs2, ACK_AGE_SAT, s.ack_age),
         commit_index=jnp.where(rs, 0, s.commit_index),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
@@ -141,7 +142,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     # selections are one-hot sums (no gather); when no sender is selected everything
     # is zeros and gated by has_ae/ae_ok downstream.
     pick_h = lambda h: jnp.sum(jnp.where(sel, h[:, None, :], 0), axis=0)  # [N, B]
-    j_in = jnp.sum(jnp.where(sel, mb.req_off, 0), axis=0)  # [N, B] in 0..E
+    j_in = jnp.sum(jnp.where(sel, mb.req_off, 0), axis=0).astype(jnp.int32)  # [N, B] in 0..E
     ws_in = pick_h(mb.ent_start)
     lcommit = pick_h(mb.req_commit)
     prev_i = jnp.where(has_ae, ws_in + j_in, 0)
@@ -206,7 +207,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     win = (role == CANDIDATE) & (n_votes >= cfg.quorum) & inp.alive
     role = jnp.where(win, LEADER, role)
     leader_id = jnp.where(win, ids2, leader_id)
-    next_index = jnp.where(win[:, None, :], (log_len + 1)[:, None, :], s.next_index)
+    # Log indices fit int16 (config caps log_capacity); keeping the [N, N, B]
+    # bookkeeping planes and their intermediates at 2 bytes halves their HBM cost.
+    len16 = log_len.astype(jnp.int16)
+    next_index = jnp.where(win[:, None, :], (len16 + 1)[:, None, :], s.next_index)
     match_index = jnp.where(win[:, None, :], 0, s.match_index)
 
     aresp = (
@@ -220,14 +224,13 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     match_index = jnp.where(a_succ, jnp.maximum(match_index, r_match), match_index)
     next_index = jnp.where(a_succ, jnp.maximum(next_index, r_match + 1), next_index)
     next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
-    # Responsiveness stamps for the shared-window filter (phase 8; see raft.py).
-    now1 = s.now + 1  # [B]
-    last_ack = jnp.where(win[:, None, :], now1[None, None, :], s.last_ack)
-    last_ack = jnp.where(aresp, now1[None, None, :], last_ack)
+    # Responsiveness ages for the shared-window filter (phase 8; see raft.py).
+    ack_age = jnp.minimum(s.ack_age + 1, ACK_AGE_SAT)
+    ack_age = jnp.where(win[:, None, :] | aresp, 0, ack_age)
 
     # ---- phase 5: leader commit advancement --------------------------------------
     is_leader = role == LEADER
-    match_with_self = jnp.where(eye3, log_len[:, None, :], match_index)  # [N, N, B]
+    match_with_self = jnp.where(eye3, len16[:, None, :], match_index)  # [N, N, B] i16
     # quorum-th largest match without a sort (TPU sorts along a non-minor axis are
     # slow). Two equivalent counting forms; pick per static shapes:
     #   cap < n  (config5: N=51, CAP=16): match values are bounded by CAP, so count
@@ -240,7 +243,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     #     (the CAP-threshold form would do ~6x the work at N=5, CAP=32 and ~400x at
     #     config1's CAP=2048).
     if cap < n:
-        vth = iota((1, 1, cap, 1), 2) + 1  # thresholds 1..CAP
+        vth = (iota((1, 1, cap, 1), 2) + 1).astype(jnp.int16)  # thresholds 1..CAP
         cnt_ge = jnp.sum(match_with_self[:, :, None, :] >= vth, axis=1)  # [N, CAP, B]
         quorum_match = jnp.sum(cnt_ge >= cfg.quorum, axis=1).astype(jnp.int32)  # [N, B]
     else:
@@ -293,20 +296,20 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_req_type = jnp.where(
         start_election, REQ_VOTE, jnp.where(send_append, REQ_APPEND, 0)
     )  # [N, B]
-    prev_out = jnp.clip(next_index - 1, 0, log_len[:, None, :])  # [src, dst, B]
+    prev_out = jnp.clip(next_index - 1, 0, len16[:, None, :])  # [src, dst, B] i16
     # Shared window start: minimum prev over RESPONSIVE peers, falling back to all
     # peers when none are (see raft.py phase 8 for the liveness argument).
-    responsive = (now1[None, None, :] - last_ack) <= cfg.ack_timeout_ticks
+    responsive = ack_age <= cfg.ack_timeout_ticks
     big = cap + 1
     ws_resp = jnp.min(jnp.where(eye3 | ~responsive, big, prev_out), axis=1)  # [N, B]
     ws_all = jnp.min(jnp.where(eye3, big, prev_out), axis=1)
     ws = jnp.where(ws_resp > cap, ws_all, ws_resp)
-    ws = jnp.minimum(ws, log_len)
+    ws = jnp.minimum(ws, len16)  # i16 throughout; widened only at the header writes
     # Clamp prev into [ws, ws+E] (see raft.py): the per-edge request payload then
     # reduces to the offset j = prev - ws in 0..E; receivers reconstruct prev,
     # prev_term, and n_entries from it and the per-sender header.
     prev_out = jnp.clip(prev_out, ws[:, None, :], (ws + e)[:, None, :])
-    out_req_off = jnp.where(ae_edge, prev_out - ws[:, None, :], 0)
+    out_req_off = jnp.where(ae_edge, prev_out - ws[:, None, :], 0).astype(jnp.int8)
     wt = log_ops.window_b(log_term_arr, ws, e)  # [N, E, B] shared window terms
     wv = log_ops.window_b(log_val_arr, ws, e)
     n_ship = jnp.clip(log_len - ws, 0, e)  # [N, B]
@@ -320,7 +323,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     # beyond the offset and response planes.
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
     out_resp_ok = (vr_granted | ar_success).astype(jnp.int32)
-    out_resp_word = out_resp_type + (out_resp_ok << 2) + (ar_match << 3)
+    out_resp_word = (out_resp_type + (out_resp_ok << 2) + (ar_match << 3)).astype(
+        jnp.int16
+    )
 
     new_mb = Mailbox(
         req_type=out_req_type,
@@ -328,7 +333,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         req_commit=jnp.where(send_append, commit, 0),
         req_last_index=jnp.where(start_election, new_last_idx, 0),
         req_last_term=jnp.where(start_election, new_last_term, 0),
-        ent_start=jnp.where(send_append, ws, 0),
+        ent_start=jnp.where(send_append, ws.astype(jnp.int32), 0),
         ent_prev_term=jnp.where(send_append, log_ops.term_at_b(log_term_arr, ws), 0),
         ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
@@ -346,7 +351,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         votes=votes,
         next_index=next_index,
         match_index=match_index,
-        last_ack=last_ack,
+        ack_age=ack_age,
         commit_index=commit,
         log_term=log_term_arr,
         log_val=log_val_arr,

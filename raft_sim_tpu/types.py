@@ -12,9 +12,12 @@ exception, client.clj:38-40), and requests/responses occupy separate mailboxes b
 request sent at tick t is handled at t+1 and its response lands at t+2, mirroring the
 reference's two-tick RPC structure (SURVEY.md section 3.2).
 
-All integers are int32; node ids are 0-based with -1 as nil (the reference uses 1-based
-ids and `nil`, core.clj:31-38). Log indices are 1-based counts like the reference/spec
-(entry i lives at array slot i-1; index 0 means "no entry", log.clj:20-23).
+Integers default to int32; the [N, N]-shaped planes ride narrower types (int16 for
+log-index bookkeeping and ack ages, int8 for window offsets -- bounds asserted by
+RaftConfig) because they dominate HBM traffic at large N. Node ids are 0-based with
+-1 as nil (the reference uses 1-based ids and `nil`, core.clj:31-38). Log indices are
+1-based counts like the reference/spec (entry i lives at array slot i-1; index 0
+means "no entry", log.clj:20-23).
 """
 
 from __future__ import annotations
@@ -24,7 +27,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from raft_sim_tpu.utils.config import RaftConfig
+# ACK_AGE_SAT is re-exported here because the kernels read it alongside
+# ClusterState; it lives in config (the leaf module) for the validator.
+from raft_sim_tpu.utils.config import ACK_AGE_SAT, RaftConfig
 from raft_sim_tpu.utils.rng import draw_timeouts
 
 # Node roles (reference keywords :follower/:candidate/:leader, core.clj:31-38;
@@ -47,7 +52,7 @@ NIL = -1  # nil node id
 
 
 class Mailbox(NamedTuple):
-    """In-flight RPC state, one tick deep. TPU-native wire format, v7.
+    """In-flight RPC state, one tick deep. TPU-native wire format, v8.
 
     Both RPCs are logically broadcasts (the reference sends RequestVote and
     AppendEntries to every peer, core.clj:48-67), and after the shared-window prev
@@ -74,7 +79,7 @@ class Mailbox(NamedTuple):
       leaderCommit = req_commit[s]
     The shared E-entry window (reference ships arbitrary per-peer suffixes,
     core.clj:59-67) starts at the minimum prev-index among RESPONSIVE peers (acked
-    an AppendEntries within config.ack_timeout_ticks, ClusterState.last_ack; falls
+    an AppendEntries within config.ack_timeout_ticks, ClusterState.ack_age; falls
     back to all peers when none are responsive, so a dead peer cannot pin the
     window start and stall replication); each peer's prev is clamped into
     [ent_start, ent_start + E], which is what makes j fit 0..E.
@@ -97,8 +102,8 @@ class Mailbox(NamedTuple):
     ent_count: jax.Array  # [N] int32: entries shipped = min(log_len - ent_start, E)
     ent_term: jax.Array  # [N, E] int32: src's shared entry window (terms)
     ent_val: jax.Array  # [N, E] int32: src's shared entry window (values)
-    req_off: jax.Array  # [N(sender), N(receiver)] int32: AE window offset j in 0..E
-    resp_word: jax.Array  # [N(receiver), N(responder)] int32: type | ok<<2 | match<<3
+    req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E
+    resp_word: jax.Array  # [N(receiver), N(responder)] int16: type | ok<<2 | match<<3
     resp_term: jax.Array  # [N(responder)] int32: responder's term at send time
 
 
@@ -119,14 +124,17 @@ class ClusterState(NamedTuple):
     voted_for: jax.Array  # [N] int32 (NIL = none)
     leader_id: jax.Array  # [N] int32 (NIL = unknown)
     votes: jax.Array  # [N, N] bool; votes[i, j] = i holds a granted vote from j
-    next_index: jax.Array  # [N, N] int32; leader i's next index for peer j
-    match_index: jax.Array  # [N, N] int32
-    # Tick at which leader i last received an AppendEntries response (success OR
-    # failure -- both prove the peer is up) from peer j; stamped to the current tick
-    # for the whole row when i wins an election (grace period). Volatile leader
-    # bookkeeping like next/match; drives the shared-entry-window responsiveness
-    # filter (config.ack_timeout_ticks).
-    last_ack: jax.Array  # [N, N] int32
+    # The three [N, N] leader-bookkeeping planes are the largest state after the
+    # mailbox; log indices fit int16 (config asserts log_capacity <= 4095) and ages
+    # saturate (ACK_AGE_SAT), halving their HBM traffic vs int32.
+    next_index: jax.Array  # [N, N] int16; leader i's next index for peer j
+    match_index: jax.Array  # [N, N] int16
+    # Ticks since leader i last received an AppendEntries response (success OR
+    # failure -- both prove the peer is up) from peer j, saturating at ACK_AGE_SAT;
+    # zeroed for the whole row when i wins an election (grace period). Volatile
+    # leader bookkeeping like next/match; drives the shared-entry-window
+    # responsiveness filter (config.ack_timeout_ticks).
+    ack_age: jax.Array  # [N, N] int16
     commit_index: jax.Array  # [N] int32
     log_term: jax.Array  # [N, CAP] int32
     log_val: jax.Array  # [N, CAP] int32
@@ -180,8 +188,8 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         ent_count=i(n),
         ent_term=i(n, e),
         ent_val=i(n, e),
-        req_off=i(n, n),
-        resp_word=i(n, n),
+        req_off=jnp.zeros((n, n), jnp.int8),
+        resp_word=jnp.zeros((n, n), jnp.int16),
         resp_term=i(n),
     )
 
@@ -198,9 +206,9 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         voted_for=jnp.full((n,), NIL, jnp.int32),
         leader_id=jnp.full((n,), NIL, jnp.int32),
         votes=jnp.zeros((n, n), bool),
-        next_index=jnp.ones((n, n), jnp.int32),
-        match_index=jnp.zeros((n, n), jnp.int32),
-        last_ack=jnp.zeros((n, n), jnp.int32),
+        next_index=jnp.ones((n, n), jnp.int16),
+        match_index=jnp.zeros((n, n), jnp.int16),
+        ack_age=jnp.full((n, n), ACK_AGE_SAT, jnp.int16),
         commit_index=jnp.zeros((n,), jnp.int32),
         log_term=jnp.zeros((n, cap), jnp.int32),
         log_val=jnp.zeros((n, cap), jnp.int32),

@@ -34,7 +34,9 @@ from raft_sim_tpu.utils.config import RaftConfig
 # v7: mailbox wire format v7 -- per-sender request headers (req_type/term/commit,
 #     RV last_index/last_term, AE window start/prev-term/count) + per-edge window
 #     offsets (req_off) and packed response words (resp_word, per-responder term).
-_FORMAT_VERSION = 7
+# v8: narrow dtypes (next/match int16, req_off int8, resp_word int16) and last_ack
+#     replaced by the saturating int16 ack_age.
+_FORMAT_VERSION = 8
 
 
 def _normalize(path: str) -> str:

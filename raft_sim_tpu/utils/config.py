@@ -16,6 +16,13 @@ from __future__ import annotations
 
 import dataclasses
 
+# Saturation ceiling for ClusterState.ack_age (ticks since a peer's last
+# AppendEntries ack; re-exported by types.py). Ages cap here instead of growing
+# without bound so the field fits int16 on arbitrarily long runs; __post_init__
+# asserts ack_timeout_ticks stays below it. Lives here (not types.py) because the
+# config validator needs it and config is the leaf module.
+ACK_AGE_SAT = 30000
+
 
 @dataclasses.dataclass(frozen=True)
 class RaftConfig:
@@ -85,7 +92,12 @@ class RaftConfig:
 
     def __post_init__(self):
         assert self.n_nodes >= 2
-        assert 1 <= self.max_entries_per_rpc <= self.log_capacity
+        # Narrow-dtype wire/state bounds (types.py): log indices ride int16 planes
+        # (next/match, and the packed response word spends 13 bits on match), the
+        # AE window offset rides int8, and ack ages saturate below int16 max.
+        assert 1 <= self.log_capacity <= 4095
+        assert 1 <= self.max_entries_per_rpc <= min(self.log_capacity, 127)
+        assert self.ack_timeout_ticks < ACK_AGE_SAT
         assert self.heartbeat_ticks >= 1
         assert self.election_min_ticks > self.heartbeat_ticks
         assert self.election_range_ticks >= 1

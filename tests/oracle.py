@@ -22,6 +22,7 @@ FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 REQ_NONE, REQ_VOTE, REQ_APPEND = 0, 1, 2
 RESP_NONE, RESP_VOTE, RESP_APPEND = 0, 1, 2
 NIL = -1
+ACK_AGE_SAT = 30000  # keep in sync with raft_sim_tpu.types.ACK_AGE_SAT
 
 
 def state_to_dict(state) -> dict:
@@ -56,9 +57,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     votes = s["votes"].copy()
     next_index = s["next_index"].copy()
     match_index = s["match_index"].copy()
-    last_ack = s["last_ack"].copy()
+    ack_age = s["ack_age"].copy()
     commit = s["commit_index"].copy()
-    now1 = int(s["now"]) + 1
     log_term = s["log_term"].copy()
     log_val = s["log_val"].copy()
     log_len = s["log_len"].copy()
@@ -75,7 +75,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, :] = False
             next_index[d, :] = 1
             match_index[d, :] = 0
-            last_ack[d, :] = 0
+            ack_age[d, :] = ACK_AGE_SAT
             commit[d] = 0
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
 
@@ -211,6 +211,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         ar_match[d, src] = last_new
 
     # ---- phase 4: responses
+    # Everyone's ack age grows one tick (saturating); stamps below zero it.
+    ack_age = np.minimum(ack_age + 1, ACK_AGE_SAT).astype(ack_age.dtype)
     for d in range(n):
         for src in range(n):
             if (
@@ -229,7 +231,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             leader_id[d] = d
             next_index[d, :] = log_len[d] + 1
             match_index[d, :] = 0
-            last_ack[d, :] = now1  # grace-stamp every peer (see raft.py phase 4)
+            ack_age[d, :] = 0  # grace-zero every peer (see raft.py phase 4)
     for d in range(n):
         if role[d] != LEADER:
             continue
@@ -247,7 +249,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             else:
                 next_index[d, src] = max(int(next_index[d, src]) - 1, 1)
             # Any AE response (success or failure) proves the peer is up.
-            last_ack[d, src] = now1
+            ack_age[d, src] = 0
 
     # ---- phase 5: leader commit advancement
     for d in range(n):
@@ -290,7 +292,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, d] = True
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
 
-    # ---- phase 8: outbox (wire format v7: per-sender headers + per-edge offsets)
+    # ---- phase 8: outbox (wire format v8: per-sender headers + per-edge offsets)
     z = lambda *shape: np.zeros(shape, np.int32)
     out = {
         "req_type": z(n),
@@ -325,7 +327,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             resp_prevs = [
                 prev_of(dst)
                 for dst in range(n)
-                if dst != src and now1 - int(last_ack[src, dst]) <= cfg.ack_timeout_ticks
+                if dst != src and int(ack_age[src, dst]) <= cfg.ack_timeout_ticks
             ]
             all_prevs = [prev_of(dst) for dst in range(n) if dst != src]
             ws = min(min(resp_prevs or all_prevs), int(log_len[src]))
@@ -366,7 +368,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "votes": votes,
         "next_index": next_index,
         "match_index": match_index,
-        "last_ack": last_ack,
+        "ack_age": ack_age,
         "commit_index": commit,
         "log_term": log_term,
         "log_val": log_val,

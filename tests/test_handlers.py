@@ -69,7 +69,7 @@ def step(cfg, s, inp=None):
     return _jitted_step(cfg)(s, inp if inp is not None else quiet_inputs(cfg))
 
 
-# Wire-format v7 helpers (Mailbox docstring): requests are per-sender broadcasts,
+# Wire-format v8 helpers (Mailbox docstring): requests are per-sender broadcasts,
 # responses are packed [receiver, responder] words + a per-responder term.
 
 
@@ -176,7 +176,7 @@ def test_revote_same_candidate_is_idempotent():
 
 
 def ae_wire(s, src, term, prev_i, prev_t, commit, ents, ent_start=None):
-    """Broadcast an AppendEntries from `src` (wire format v7): the shared window is
+    """Broadcast an AppendEntries from `src` (wire format v8): the shared window is
     positioned at `ent_start` (default prev_i, i.e. offset j = 0) and every edge
     carries the offset j = prev_i - ent_start, so each receiver reconstructs
     (prev_i, prev_t, ents). For j >= 1 the window slot j-1 holds prev_t, as a real
@@ -277,7 +277,7 @@ def make_leader(s, node, term):
         term=s.term.at[node].set(term),
         leader_id=jnp.full((n,), node, jnp.int32),
         next_index=s.next_index.at[node].set(
-            jnp.full((n,), int(s.log_len[node]) + 1, jnp.int32)
+            jnp.full((n,), int(s.log_len[node]) + 1, jnp.int16)
         ),
     )
 
@@ -382,7 +382,7 @@ def test_leader_does_not_commit_older_term_entries():
     leader is at term 3 -> no commit even with full match."""
     s = with_log(base_state(), 0, [1, 1])
     s = make_leader(s, 0, 3)
-    s = s._replace(match_index=s.match_index.at[0].set(jnp.full((5,), 2, jnp.int32)))
+    s = s._replace(match_index=s.match_index.at[0].set(jnp.full((5,), 2, jnp.int16)))
     s2, _ = step(CFG, s)
     assert int(s2.commit_index[0]) == 0
 
@@ -410,7 +410,7 @@ def test_leader_heartbeats_on_timer():
     # Peers haven't acked entry 1 yet: nextIndex = 1 -> the heartbeat ships it.
     s = s._replace(
         deadline=s.deadline.at[0].set(1),
-        next_index=s.next_index.at[0].set(jnp.ones((5,), jnp.int32)),
+        next_index=s.next_index.at[0].set(jnp.ones((5,), jnp.int16)),
     )
     s2, _ = step(CFG, s)
     assert int(s2.mailbox.req_type[0]) == REQ_APPEND
@@ -453,7 +453,7 @@ def test_restart_wipes_volatile_keeps_persistent():
     s = s._replace(
         voted_for=s.voted_for.at[0].set(0),
         votes=s.votes.at[0].set(jnp.ones((5,), bool)),
-        match_index=s.match_index.at[0].set(jnp.full((5,), 3, jnp.int32)),
+        match_index=s.match_index.at[0].set(jnp.full((5,), 3, jnp.int16)),
         commit_index=s.commit_index.at[0].set(3),
     )
     inp = quiet_inputs(CFG)._replace(restarted=jnp.zeros((5,), bool).at[0].set(True))

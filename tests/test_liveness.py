@@ -7,7 +7,7 @@ would pin the window start forever, and no live follower could ever receive
 entries past window_start + E: commit would stall despite a live quorum -- a
 liveness loss the reference cannot have, since it ships unbounded per-peer log
 suffixes (core.clj:59-67). The responsiveness filter (config.ack_timeout_ticks,
-ClusterState.last_ack) drops never-acking peers out of the window-start min;
+ClusterState.ack_age) drops never-acking peers out of the window-start min;
 these tests pin that behavior end to end.
 """
 
