@@ -15,15 +15,17 @@ free, and tests/test_pallas.py pins it (interpret mode on CPU, compiled on TPU).
 Shape handling: TPU Pallas wants >=2-D refs, so rank-1 leaves ([B]-shaped: state.now,
 client_cmd, and every StepInfo field) cross the boundary as [1, B].
 
-STATUS on this image's toolchain: interpret mode (CPU) is fully working and
-parity-tested (tests/test_pallas.py). The compiled TPU path lowers through Mosaic
-(after two kernel-side fixes that also live in raft_batched.py: rank-final
-broadcasted_iota constants instead of unit-dim reshapes, and boolean arithmetic
-instead of where-on-bools, which Mosaic cannot select on), but the final TPU
-compilation step crashes (SIGABRT) in this image's libtpu for the full ~70-op tick
-graph — individual phases compile and run fine. The XLA batch-minor path
-(scan.run_batch_minor, 24M cluster-ticks/s/chip) therefore remains the default
-engine; revisit when libtpu updates.
+STATUS — PARKED (decision, round 2; see docs/DESIGN.md "Pallas engine"): interpret
+mode (CPU) works and is parity-tested every run (tests/test_pallas.py), which pins
+that the tick kernel remains pallas_call-compatible. The compiled TPU path is
+blocked by this image's Mosaic toolchain, not by kernel structure: the original
+int32 tick graph SIGABRTed libtpu at the final compile step (individual phases
+compiled fine), and after the v8 wire format narrowed state to int16/int8 Mosaic
+now rejects it earlier with "Reductions over int16 not implemented". Meanwhile the
+XLA batch-minor path hit 34.8M cluster-ticks/s/chip (config3) with XLA's own
+fusions, so the headroom a hand-fused kernel could add no longer justifies
+maintaining a second compile path against a toolchain that cannot lower it.
+Revisit if libtpu/Mosaic gains int16 reductions.
 """
 
 from __future__ import annotations
