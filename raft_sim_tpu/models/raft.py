@@ -89,6 +89,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         match_index=jnp.where(rs[:, None], 0, s.match_index),
         ack_age=jnp.where(rs[:, None], ACK_AGE_SAT, s.ack_age),
         commit_index=jnp.where(rs, 0, s.commit_index),
+        commit_chk=jnp.where(rs, jnp.uint32(0), s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
     mb = s.mailbox
@@ -396,6 +397,18 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         resp_term=term,
     )
 
+    # Committed-prefix checksum (log_ops module comment): one masked pass over the
+    # new arrays yields both the old-prefix sum (invariant: equals the carried
+    # checksum) and the new-prefix sum (the carried value for next tick).
+    if cfg.check_invariants:
+        chk_old, chk_new = log_ops.prefix_chk2(
+            log_term_arr, log_val_arr, s.commit_index, commit
+        )
+        chk_ok = chk_old == s.commit_chk
+    else:
+        chk_new = s.commit_chk
+        chk_ok = jnp.ones((n,), bool)
+
     new_state = ClusterState(
         role=role,
         term=term,
@@ -406,6 +419,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         match_index=match_index,
         ack_age=ack_age,
         commit_index=commit,
+        commit_chk=chk_new,
         log_term=log_term_arr,
         log_val=log_val_arr,
         log_len=log_len,
@@ -415,7 +429,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         mailbox=new_mb,
     )
 
-    info = _step_info(cfg, s, new_state, req_in, resp_in, inp.alive, do_inject)
+    info = _step_info(cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok)
     return new_state, info
 
 
@@ -427,6 +441,7 @@ def _step_info(
     resp_in: jax.Array,
     alive: jax.Array,
     do_inject: jax.Array,
+    chk_ok: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
     n = cfg.n_nodes
@@ -452,16 +467,13 @@ def _step_info(
         # Commit sanity: monotonic, within the log, and the committed prefix is
         # immutable -- entries below the old commit index never change term OR value
         # (state-machine-safety analogue of the reference's apply-entries! writing
-        # committed values to an append-only file, log.clj:69-76).
-        ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
-        was_committed = ks[None, :] < old.commit_index[:, None]
-        rewrote = was_committed & (
-            (new.log_term != old.log_term) | (new.log_val != old.log_val)
-        )
+        # committed values to an append-only file, log.clj:69-76). Immutability is
+        # checked via the carried prefix checksum (chk_ok; log_ops module comment).
         viol_commit = jnp.any(
             (new.commit_index < old.commit_index)
             | (new.commit_index > new.log_len)
-        ) | jnp.any(rewrote)
+            | ~chk_ok
+        )
     else:
         viol_election = f
         viol_commit = f

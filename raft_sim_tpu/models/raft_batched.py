@@ -74,6 +74,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         match_index=jnp.where(rs2, 0, s.match_index),
         ack_age=jnp.where(rs2, ACK_AGE_SAT, s.ack_age),
         commit_index=jnp.where(rs, 0, s.commit_index),
+        commit_chk=jnp.where(rs, jnp.uint32(0), s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
     mb = s.mailbox
@@ -343,6 +344,16 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         resp_term=term,
     )
 
+    # Committed-prefix checksum (log_ops module comment; raft.py).
+    if cfg.check_invariants:
+        chk_old, chk_new = log_ops.prefix_chk2_b(
+            log_term_arr, log_val_arr, s.commit_index, commit
+        )
+        chk_ok = chk_old == s.commit_chk
+    else:
+        chk_new = s.commit_chk
+        chk_ok = jnp.ones_like(s.commit_index, dtype=bool)
+
     new_state = ClusterState(
         role=role,
         term=term,
@@ -353,6 +364,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         match_index=match_index,
         ack_age=ack_age,
         commit_index=commit,
+        commit_chk=chk_new,
         log_term=log_term_arr,
         log_val=log_val_arr,
         log_len=log_len,
@@ -362,7 +374,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         mailbox=new_mb,
     )
 
-    info = _step_info_b(cfg, s, new_state, req_in, resp_in, inp.alive, do_inject)
+    info = _step_info_b(cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok)
     return new_state, info
 
 
@@ -374,6 +386,7 @@ def _step_info_b(
     resp_in: jax.Array,
     alive: jax.Array,
     do_inject: jax.Array,
+    chk_ok: jax.Array,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
@@ -392,14 +405,13 @@ def _step_info_b(
             & ~eye3
         )
         viol_election = jnp.any(pair_bad, axis=(0, 1))
-        was_committed = iota((1, cfg.log_capacity, 1), 1) < old.commit_index[:, None, :]
-        rewrote = was_committed & (
-            (new.log_term != old.log_term) | (new.log_val != old.log_val)
-        )
+        # Committed-prefix immutability via the carried checksum (raft._step_info).
         viol_commit = jnp.any(
-            (new.commit_index < old.commit_index) | (new.commit_index > new.log_len),
+            (new.commit_index < old.commit_index)
+            | (new.commit_index > new.log_len)
+            | ~chk_ok,
             axis=0,
-        ) | jnp.any(rewrote, axis=(0, 1))
+        )
     else:
         viol_election = f
         viol_commit = f

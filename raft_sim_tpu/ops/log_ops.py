@@ -84,6 +84,63 @@ def write_window(
 
 
 # --------------------------------------------------------------------------------------
+# Committed-prefix checksum. The "committed entries are immutable" invariant used to
+# compare the full old vs new log arrays every tick -- the single most expensive
+# fusion of the config3 tick (~15%, it re-reads 4 [N, CAP, B] arrays). Instead the
+# state carries a weighted checksum of the committed prefix (ClusterState.commit_chk):
+# one masked pass over the NEW arrays both recomputes the old-prefix sum (must equal
+# the carried checksum -- any rewrite of a committed slot changes it w.h.p.) and
+# extends it to the new commit bound. Detection is probabilistic (a rewrite must
+# preserve a weighted sum mod 2^32 to escape; weights are odd mixing constants), which
+# is ample for an implementation-bug detector, and it additionally catches committed
+# -prefix corruption *between* ticks, which the old same-tick compare could not.
+# Weights formula duplicated in tests/oracle.py -- keep in sync.
+# --------------------------------------------------------------------------------------
+
+
+def chk_weights(cap: int):
+    """Per-slot odd uint32 mixing weights (terms, values) for the prefix checksum."""
+    k = jnp.arange(cap, dtype=jnp.uint32)
+    w_term = (k * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
+    w_val = (k * jnp.uint32(0x85EBCA77) + jnp.uint32(0xC2B2AE3D)) | jnp.uint32(1)
+    return w_term, w_val
+
+
+def prefix_chk2(log_term, log_val, upto_a, upto_b):
+    """Checksums of the prefixes below 1-based counts `upto_a` and `upto_b`, in one
+    pass. log_term/log_val: [N, CAP]; upto_*: [N] -> (uint32 [N], uint32 [N])."""
+    cap = log_term.shape[-1]
+    w_t, w_v = chk_weights(cap)
+    contrib = log_term.astype(jnp.uint32) * w_t + log_val.astype(jnp.uint32) * w_v
+    ks = jnp.arange(cap, dtype=jnp.int32)
+    in_a = ks[None, :] < upto_a[:, None]
+    in_b = ks[None, :] < upto_b[:, None]
+    z = jnp.uint32(0)
+    return (
+        jnp.sum(jnp.where(in_a, contrib, z), axis=1, dtype=jnp.uint32),
+        jnp.sum(jnp.where(in_b, contrib, z), axis=1, dtype=jnp.uint32),
+    )
+
+
+def prefix_chk2_b(log_term, log_val, upto_a, upto_b):
+    """Batch-minor prefix_chk2. log_term/log_val: [N, CAP, B]; upto_*: [N, B]."""
+    cap = log_term.shape[1]
+    w_t, w_v = chk_weights(cap)
+    contrib = (
+        log_term.astype(jnp.uint32) * w_t[None, :, None]
+        + log_val.astype(jnp.uint32) * w_v[None, :, None]
+    )
+    ks = iota((1, cap, 1), 1)
+    in_a = ks < upto_a[:, None, :]
+    in_b = ks < upto_b[:, None, :]
+    z = jnp.uint32(0)
+    return (
+        jnp.sum(jnp.where(in_a, contrib, z), axis=1, dtype=jnp.uint32),
+        jnp.sum(jnp.where(in_b, contrib, z), axis=1, dtype=jnp.uint32),
+    )
+
+
+# --------------------------------------------------------------------------------------
 # Batch-minor variants: identical semantics with a trailing batch axis B. The batch
 # rides the TPU lane dimension (128-wide minor tile), so these are the hot-path forms
 # (models/raft_batched.py); the unsuffixed single-cluster forms above stay as the

@@ -136,6 +136,13 @@ class ClusterState(NamedTuple):
     # responsiveness filter (config.ack_timeout_ticks).
     ack_age: jax.Array  # [N, N] int16
     commit_index: jax.Array  # [N] int32
+    # Weighted checksum of the committed prefix (log_ops.chk_weights), maintained
+    # when config.check_invariants: the "committed entries are immutable" invariant
+    # checks one pass over the new log arrays against this instead of re-reading the
+    # old arrays every tick. Stays 0 when invariant checking is off. Hand-built
+    # states that set commit_index directly must refresh it via
+    # types.with_commit_chk (the invariant trips otherwise -- by design).
+    commit_chk: jax.Array  # [N] uint32
     log_term: jax.Array  # [N, CAP] int32
     log_val: jax.Array  # [N, CAP] int32
     log_len: jax.Array  # [N] int32
@@ -210,6 +217,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         match_index=jnp.zeros((n, n), jnp.int16),
         ack_age=jnp.full((n, n), ACK_AGE_SAT, jnp.int16),
         commit_index=jnp.zeros((n,), jnp.int32),
+        commit_chk=jnp.zeros((n,), jnp.uint32),
         log_term=jnp.zeros((n, cap), jnp.int32),
         log_val=jnp.zeros((n, cap), jnp.int32),
         log_len=jnp.zeros((n,), jnp.int32),
@@ -218,6 +226,17 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         now=jnp.int32(0),
         mailbox=empty_mailbox(cfg),
     )
+
+
+def with_commit_chk(state: ClusterState) -> ClusterState:
+    """Refresh commit_chk from the current log arrays + commit_index (single-cluster
+    state). For tests and state surgery that set commit_index by hand."""
+    from raft_sim_tpu.ops import log_ops
+
+    chk, _ = log_ops.prefix_chk2(
+        state.log_term, state.log_val, state.commit_index, state.commit_index
+    )
+    return state._replace(commit_chk=chk)
 
 
 def init_batch(cfg: RaftConfig, key: jax.Array, batch: int) -> ClusterState:

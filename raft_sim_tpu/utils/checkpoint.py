@@ -36,7 +36,8 @@ from raft_sim_tpu.utils.config import RaftConfig
 #     offsets (req_off) and packed response words (resp_word, per-responder term).
 # v8: narrow dtypes (next/match int16, req_off int8, resp_word int16) and last_ack
 #     replaced by the saturating int16 ack_age.
-_FORMAT_VERSION = 8
+# v9: ClusterState gained commit_chk (committed-prefix checksum).
+_FORMAT_VERSION = 9
 
 
 def _normalize(path: str) -> str:

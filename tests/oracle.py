@@ -59,6 +59,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     match_index = s["match_index"].copy()
     ack_age = s["ack_age"].copy()
     commit = s["commit_index"].copy()
+    commit_chk = s["commit_chk"].copy()
     log_term = s["log_term"].copy()
     log_val = s["log_val"].copy()
     log_len = s["log_len"].copy()
@@ -77,6 +78,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             match_index[d, :] = 0
             ack_age[d, :] = ACK_AGE_SAT
             commit[d] = 0
+            commit_chk[d] = 0
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
 
     # ---- phase 0: delivery
@@ -292,6 +294,17 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, d] = True
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
 
+    # ---- committed-prefix checksum (log_ops.chk_weights -- keep formula in sync)
+    if cfg.check_invariants:
+        M = (1 << 32) - 1
+        for d in range(n):
+            acc = 0
+            for k in range(int(commit[d])):
+                w_t = ((k * 2654435761 + 0x9E3779B9) | 1) & M
+                w_v = ((k * 0x85EBCA77 + 0xC2B2AE3D) | 1) & M
+                acc = (acc + int(log_term[d, k]) * w_t + int(log_val[d, k]) * w_v) & M
+            commit_chk[d] = np.uint32(acc)
+
     # ---- phase 8: outbox (wire format v8: per-sender headers + per-edge offsets)
     z = lambda *shape: np.zeros(shape, np.int32)
     out = {
@@ -370,6 +383,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "match_index": match_index,
         "ack_age": ack_age,
         "commit_index": commit,
+        "commit_chk": commit_chk,
         "log_term": log_term,
         "log_val": log_val,
         "log_len": log_len,

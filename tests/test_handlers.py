@@ -22,6 +22,7 @@ from raft_sim_tpu import (
     init_state,
 )
 from raft_sim_tpu.models import raft
+from raft_sim_tpu import types as raft_types
 from raft_sim_tpu.types import REQ_APPEND, REQ_VOTE, RESP_APPEND, RESP_VOTE
 
 CFG = RaftConfig(n_nodes=5, log_capacity=8, max_entries_per_rpc=4)
@@ -456,6 +457,7 @@ def test_restart_wipes_volatile_keeps_persistent():
         match_index=s.match_index.at[0].set(jnp.full((5,), 3, jnp.int16)),
         commit_index=s.commit_index.at[0].set(3),
     )
+    s = raft_types.with_commit_chk(s)  # hand-set commit needs a matching checksum
     inp = quiet_inputs(CFG)._replace(restarted=jnp.zeros((5,), bool).at[0].set(True))
     s2, info = step(CFG, s, inp)
     # Persistent: term, vote, log survive.
@@ -535,3 +537,18 @@ def test_append_shared_window_rebase():
     assert int(s2.log_len[1]) == 2
     np.testing.assert_array_equal(np.asarray(s2.log_term[1, :2]), [1, 2])
     np.testing.assert_array_equal(np.asarray(s2.log_val[1, :2]), [100, 7])
+
+
+def test_committed_prefix_corruption_detected():
+    """The carried-checksum invariant (log_ops module comment) must flag a committed
+    entry whose value changes -- including corruption introduced BETWEEN ticks, which
+    the old same-tick old-vs-new compare could not see."""
+    s = with_log(base_state(), 0, [1, 1, 1])
+    s = make_leader(s, 0, 1)
+    s = s._replace(commit_index=s.commit_index.at[0].set(2))
+    s = raft_types.with_commit_chk(s)
+    _, info = step(CFG, s)
+    assert not bool(info.viol_commit)  # consistent state: no violation
+    corrupted = s._replace(log_val=s.log_val.at[0, 1].set(999))  # committed slot
+    _, info = step(CFG, corrupted)
+    assert bool(info.viol_commit)
