@@ -552,3 +552,49 @@ def test_committed_prefix_corruption_detected():
     corrupted = s._replace(log_val=s.log_val.at[0, 1].set(999))  # committed slot
     _, info = step(CFG, corrupted)
     assert bool(info.viol_commit)
+
+
+def test_window_fallback_when_no_peer_responsive():
+    """A leader whose peers ALL aged out of the ack window (total isolation longer
+    than ack_timeout_ticks) falls back to the min prev over all peers for the shared
+    window start, so its next heartbeat still ships the entries a healed laggard
+    needs (raft.py phase 8 fallback arm)."""
+    s = with_log(base_state(), 0, [1, 1, 1])
+    s = make_leader(s, 0, 1)
+    s = s._replace(
+        deadline=s.deadline.at[0].set(1),  # heartbeat due now
+        # Peer 1 is far behind (next=1 -> prev=0); everyone stale beyond the window.
+        next_index=s.next_index.at[0, 1].set(jnp.int16(1)),
+        ack_age=s.ack_age.at[0].set(
+            jnp.full((5,), CFG.ack_timeout_ticks + 5, jnp.int16)
+        ),
+    )
+    s2, _ = step(CFG, s)
+    assert int(s2.mailbox.req_type[0]) == REQ_APPEND
+    # Fallback: window starts at the ALL-peers min prev (0), not at the responsive
+    # min (which is empty); entries from slot 0 ship.
+    assert int(s2.mailbox.ent_start[0]) == 0
+    assert int(s2.mailbox.ent_count[0]) == 3
+    assert int(s2.mailbox.req_off[0, 1]) == 0
+
+
+def test_stale_peer_excluded_from_window_start():
+    """A single unresponsive laggard must NOT pin the window: the shared window
+    starts at the min prev over RESPONSIVE peers, and the stale peer's offset is
+    lifted to the window start."""
+    s = with_log(base_state(), 0, [1, 1, 1])
+    s = make_leader(s, 0, 1)
+    ages = jnp.zeros((5,), jnp.int16).at[1].set(CFG.ack_timeout_ticks + 5)
+    s = s._replace(
+        deadline=s.deadline.at[0].set(1),
+        # Stale peer 1 is far behind; responsive peers 2-4 are at prev=2.
+        next_index=s.next_index.at[0].set(
+            jnp.asarray([4, 1, 3, 3, 3], jnp.int16)
+        ),
+        ack_age=s.ack_age.at[0].set(ages),
+    )
+    s2, _ = step(CFG, s)
+    assert int(s2.mailbox.req_type[0]) == REQ_APPEND
+    assert int(s2.mailbox.ent_start[0]) == 2  # responsive min, not peer 1's 0
+    assert int(s2.mailbox.req_off[0, 1]) == 0  # stale peer lifted to window start
+    assert int(s2.mailbox.req_off[0, 2]) == 0  # responsive peers at their own prev

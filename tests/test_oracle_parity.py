@@ -67,6 +67,22 @@ CONFIGS = [
         4,
         id="n5-crashes",
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=2,
+            drop_prob=0.5,  # heavy loss: peers regularly fall out of the ack window
+            ack_timeout_ticks=7,  # the tightest legal horizon (heartbeat 3 + 4)
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=12,
+        ),
+        5,
+        id="n5-ack-window",  # exercises responsiveness exclusion + re-admission in
+        # the shared-window start (the no-responsive fallback needs a deterministic
+        # scenario: test_handlers.test_window_fallback_when_no_peer_responsive)
+    ),
 ]
 
 
