@@ -64,12 +64,21 @@ class Session:
     >>> s.run(1000)        # scan forward, accumulating metrics
     >>> s.summary()        # fleet rollup dict
     >>> s.reset()          # back to tick 0 with the same seed (user/reset)
+
+    `devices=N` shards the cluster batch over the first N local devices (a 1-D
+    `parallel.make_mesh`): the jitted chunk calls see sharded inputs and XLA keeps
+    the whole scan sharded -- the tick body has no cross-cluster ops, so no
+    collectives appear in the hot loop. Trajectories are bit-identical at any
+    device count (keys are split before sharding; pinned by tests/test_parallel.py).
     """
 
-    def __init__(self, cfg: RaftConfig, batch: int = 1, seed: int = 0):
+    def __init__(
+        self, cfg: RaftConfig, batch: int = 1, seed: int = 0, devices: int | None = None
+    ):
         self.cfg = cfg
         self.batch = batch
         self.seed = seed
+        self.devices = devices
         self.reset()
 
     def reset(self) -> None:
@@ -80,6 +89,28 @@ class Session:
         self.state = init_batch(self.cfg, k_init, self.batch)
         self.keys = jax.random.split(k_run, self.batch)
         self.metrics = scan.init_metrics_batch(self.batch)
+        self._apply_sharding()
+
+    def _apply_sharding(self) -> None:
+        if self.devices is None:
+            return
+        if self.devices < 1:
+            raise ValueError(f"devices must be >= 1, got {self.devices}")
+        if self.batch % self.devices:
+            raise ValueError(
+                f"batch {self.batch} must divide over {self.devices} devices"
+            )
+        if self.devices == 1:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from raft_sim_tpu.parallel import mesh as pmesh
+
+        sh = NamedSharding(pmesh.make_mesh(self.devices), P(pmesh.AXIS))
+        place = lambda t: jax.tree.map(lambda x: jax.device_put(x, sh), t)
+        self.state = place(self.state)
+        self.keys = jax.device_put(self.keys, sh)
+        self.metrics = place(self.metrics)
 
     def run(self, n_ticks: int, chunk: int = 4096, progress: bool = False) -> None:
         def cb(done, _state, metrics):
@@ -114,18 +145,21 @@ class Session:
         )
 
     @classmethod
-    def restore(cls, path: str) -> "Session":
+    def restore(cls, path: str, devices: int | None = None) -> "Session":
         """Resume exactly: state, keys, accumulated metrics, AND the original seed come
         back, so summary() after more run() calls matches a never-interrupted session
-        and reset() rebuilds the same experiment."""
+        and reset() rebuilds the same experiment. `devices` reshards on load (a
+        checkpoint is device-layout agnostic)."""
         cfg, state, keys, metrics, seed = checkpoint.load(path)
         self = cls.__new__(cls)
         self.cfg = cfg
         self.batch = state.role.shape[0]
         self.seed = seed
+        self.devices = devices
         self.state = state
         self.keys = keys
         self.metrics = metrics
+        self._apply_sharding()
         return self
 
 
@@ -184,6 +218,9 @@ def main(argv=None) -> int:
     run_p.add_argument("--profile", metavar="DIR", default=None,
                        help="capture a jax.profiler trace of the run into DIR "
                             "(view with tensorboard/xprof)")
+    run_p.add_argument("--devices", type=int, default=None, metavar="N",
+                       help="shard the cluster batch over the first N local devices "
+                            "(trajectories are device-count invariant)")
     run_p.add_argument("--progress", action="store_true")
     run_p.add_argument("--trace-ticks", type=int, default=0,
                        help="print per-tick info lines for one cluster")
@@ -219,10 +256,15 @@ def main(argv=None) -> int:
             conflicting.append("seed")  # the checkpoint carries its own seed
         if conflicting:
             ap.error(f"--resume is exclusive with config flags: {', '.join(conflicting)}")
-        sess = Session.restore(args.resume)
+        sess = Session.restore(args.resume, devices=args.devices)
     else:
         cfg, batch = build_config(args)
-        sess = Session(cfg, batch=batch, seed=args.seed if args.seed is not None else 0)
+        sess = Session(
+            cfg,
+            batch=batch,
+            seed=args.seed if args.seed is not None else 0,
+            devices=args.devices,
+        )
 
     if args.trace_ticks or args.trace_events:
         if args.save or args.profile:

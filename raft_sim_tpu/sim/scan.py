@@ -26,9 +26,11 @@ from raft_sim_tpu.types import NIL, ClusterState, StepInfo
 from raft_sim_tpu.utils.config import RaftConfig
 
 # Sentinel for "never happened" tick values (first leader, stable leader). Public so
-# consumers (parallel.summarize, tests) compare against the same constant.
+# consumers (parallel.summarize, tests) compare against the same constant. Kept a
+# plain Python int: a module-level jnp array would initialize the JAX backend at
+# import time, before driver.select_backend can pick the platform.
 NEVER = 2**31 - 1
-_BIG = jnp.int32(NEVER)
+_BIG = NEVER
 
 
 class RunMetrics(NamedTuple):
@@ -60,7 +62,7 @@ def init_metrics() -> RunMetrics:
     z = jnp.int32(0)
     return RunMetrics(
         violations=z,
-        first_leader_tick=_BIG,
+        first_leader_tick=jnp.int32(NEVER),
         last_leaderless_tick=jnp.int32(-1),
         max_term=z,
         max_commit=z,
