@@ -256,15 +256,21 @@ def main(argv=None) -> int:
             conflicting.append("seed")  # the checkpoint carries its own seed
         if conflicting:
             ap.error(f"--resume is exclusive with config flags: {', '.join(conflicting)}")
-        sess = Session.restore(args.resume, devices=args.devices)
+        try:
+            sess = Session.restore(args.resume, devices=args.devices)
+        except ValueError as ex:  # --devices misuse: argparse-style error, no traceback
+            ap.error(str(ex))
     else:
         cfg, batch = build_config(args)
-        sess = Session(
-            cfg,
-            batch=batch,
-            seed=args.seed if args.seed is not None else 0,
-            devices=args.devices,
-        )
+        try:
+            sess = Session(
+                cfg,
+                batch=batch,
+                seed=args.seed if args.seed is not None else 0,
+                devices=args.devices,
+            )
+        except ValueError as ex:
+            ap.error(str(ex))
 
     if args.trace_ticks or args.trace_events:
         if args.save or args.profile:

@@ -1,9 +1,17 @@
 from raft_sim_tpu.parallel.mesh import (
     AXIS,
     FleetSummary,
+    init_distributed,
     make_mesh,
     simulate_sharded,
     summarize,
 )
 
-__all__ = ["AXIS", "FleetSummary", "make_mesh", "simulate_sharded", "summarize"]
+__all__ = [
+    "AXIS",
+    "FleetSummary",
+    "init_distributed",
+    "make_mesh",
+    "simulate_sharded",
+    "summarize",
+]

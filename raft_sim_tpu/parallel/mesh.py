@@ -31,6 +31,33 @@ from raft_sim_tpu.utils.config import RaftConfig
 AXIS = "clusters"
 
 
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Multi-host bootstrap: join this host's chips into the global device mesh.
+
+    The reference's cross-node transport is point-to-point HTTP between OS
+    processes (server.clj/client.clj); here multi-HOST scaling is pure
+    orchestration -- clusters are independent, so a pod just shards the batch
+    axis over every chip of every host. This wraps `jax.distributed.initialize`
+    (args fall back to the standard JAX env vars / TPU pod auto-detection; DCN
+    carries only this control plane, never tick traffic). Call once per host
+    process before any computation; afterwards `jax.devices()` is the global
+    device list, `make_mesh()` builds the global 1-D mesh, and
+    `simulate_sharded` runs with each host touching only its addressable
+    shards (`summarize` then needs a host-local slice or a process-0 gather).
+    Returns this host's process index.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_index()
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """1-D mesh over the flat device list; the single named axis shards the batch of
     independent clusters (the rebuild's only data-parallel axis, SURVEY.md section 2)."""

@@ -598,3 +598,18 @@ def test_stale_peer_excluded_from_window_start():
     assert int(s2.mailbox.ent_start[0]) == 2  # responsive min, not peer 1's 0
     assert int(s2.mailbox.req_off[0, 1]) == 0  # stale peer lifted to window start
     assert int(s2.mailbox.req_off[0, 2]) == 0  # responsive peers at their own prev
+
+
+def test_stale_append_entries_nacked_with_newer_term():
+    """An AE from a deposed leader (lower term) must be rejected, and the response
+    must carry the follower's newer term so the stale leader steps down (the
+    request side of core.clj:144-145's step-down; spec 5.1)."""
+    s = base_state()
+    s = s._replace(term=s.term.at[1].set(5))
+    s = ae_wire(s, 0, term=3, prev_i=0, prev_t=0, commit=0, ents=[(3, 7)])
+    s2, _ = step(CFG, s)
+    assert int(s2.log_len[1]) == 0  # nothing appended
+    assert resp_type_of(s2.mailbox, 0, 1) == RESP_APPEND  # still answered
+    assert not resp_ok_of(s2.mailbox, 0, 1)
+    assert int(s2.mailbox.resp_term[1]) == 5  # carries the newer term
+    assert int(s2.leader_id[1]) == NIL  # stale sender not adopted as leader
