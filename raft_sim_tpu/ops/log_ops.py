@@ -213,11 +213,21 @@ def write_window_b(
 
     Window positions are strictly increasing in k, so each capacity slot is hit by at
     most one unmasked entry; masked entries are routed to position `cap`, which matches
-    no slot (the scatter form's mode='drop')."""
+    no slot (the scatter form's mode='drop').
+
+    PRECONDITION (unlike the general scatter form): `mask` must be a contiguous
+    prefix along E -- mask[n, k, b] == gate[n, b] & (k < count[n, b]) -- which is
+    what every kernel write site produces; the written-slot test below relies on
+    it."""
     cap = arr.shape[1]
     pos = start0[:, None, :] + iota((1, vals.shape[1], 1), 1)  # [N, E, B]
     pos = jnp.where(mask, pos, cap)
     oh = iota((1, 1, cap, 1), 2) == pos[:, :, None, :]  # [N, E, CAP, B]
-    hit = jnp.any(oh, axis=1)  # [N, CAP, B]
+    # The kernel's write masks are always contiguous prefixes (mask = gate & (k <
+    # n_ent)), so the positions form the range [start0, start0 + count) and the
+    # written-slot test is two compares instead of an E-way any-reduce over `oh`.
+    count = jnp.sum(mask, axis=1).astype(jnp.int32)  # [N, B]
+    cs = iota((1, cap, 1), 1)
+    hit = (cs >= start0[:, None, :]) & (cs < (start0 + count)[:, None, :])
     val = jnp.sum(jnp.where(oh, vals[:, :, None, :], 0), axis=1)
     return jnp.where(hit, val, arr)
