@@ -1,0 +1,36 @@
+"""Bitrot insurance for the repo-root driver artifacts: bench.py's measurement
+harness and __graft_entry__.py's compile-contract entry points must keep working
+as the kernels evolve (both are executed by external automation, so nothing else
+in the suite touches them)."""
+
+import sys
+
+import jax
+import pytest
+
+
+sys.path.insert(0, ".")  # repo root: bench.py / __graft_entry__.py live there
+
+
+def test_bench_harness_runs_cpu_sized():
+    import bench
+
+    from raft_sim_tpu import RaftConfig
+
+    row = bench.bench(RaftConfig(n_nodes=5), batch=64, ticks=50, repeats=1)
+    assert row["violations"] == 0
+    assert row["cluster_ticks_per_s"] > 0
+    assert 0 <= row["pct_stable"] <= 100
+    # Quality fields come from the fixed-seed run: a second invocation agrees.
+    row2 = bench.bench(RaftConfig(n_nodes=5), batch=64, ticks=50, repeats=1)
+    assert row["p50_stable_tick"] == row2["p50_stable_tick"]
+    assert row["pct_stable"] == row2["pct_stable"]
+
+
+def test_graft_entry_compiles():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn).lower(*args).compile()(*args)
+    new_state, info = out
+    assert new_state.role.shape == args[0].role.shape
