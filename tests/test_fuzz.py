@@ -165,3 +165,31 @@ def test_leader_crash_triggers_reelection():
         if found:
             break
     assert found, "no leader-crash -> re-election event observed across 8 seeds"
+
+
+def test_kitchen_sink_all_faults_at_once():
+    """Every fault class simultaneously -- Bernoulli drop (uniform per-cluster rate),
+    rolling partitions, clock skew, node crash/restart -- with client traffic and
+    FULL invariant checking (election safety, commit sanity via the carried
+    checksum, committed-prefix value log matching) every tick. Safety must hold
+    unconditionally; liveness is only required of clusters the fault mix actually
+    lets breathe (we assert a majority elects at least once, and that the fleet
+    commits)."""
+    cfg = RaftConfig(
+        n_nodes=5,
+        log_capacity=64,
+        client_interval=4,
+        drop_prob=0.3,
+        drop_prob_uniform=True,
+        clock_skew_prob=0.15,
+        partition_period=40,
+        partition_prob=0.5,
+        crash_prob=0.3,
+        crash_period=40,
+        crash_down_ticks=15,
+        check_log_matching=True,
+    )
+    m = metrics_of(cfg, 11, 64, 400)
+    assert int(m.violations.sum()) == 0
+    assert int((m.first_leader_tick < NEVER).sum()) > 32
+    assert int(m.max_commit.max()) > 0
