@@ -124,6 +124,27 @@ class Session:
         )
         self.metrics = chunked.merge_metrics(self.metrics, m)
 
+    def offer(self, value: int) -> dict:
+        """Offer one client command to every cluster's current leader and advance one
+        tick -- the reference's ad-hoc `curl POST /client-set` (server.clj:8-12,
+        core.clj:151-160), minus the redirect dance (membership is globally visible
+        here; see models/raft.py phase 6). Overrides that tick's scheduled client
+        input, metrics accumulate as in run(). Returns {"accepted": count} --
+        clusters whose live leader appended the value (no leader -> not accepted,
+        unlike the reference's never-firing commit watch, bug 2.3.9).
+        """
+        value = int(value)
+        from raft_sim_tpu.types import NIL
+
+        if value == NIL:
+            raise ValueError(f"command value {NIL} collides with the NIL sentinel")
+        if not -(2**31) <= value < 2**31:
+            raise ValueError(f"command value must fit int32, got {value}")
+        self.state, self.metrics, accepted = _offer_tick(
+            self.cfg, self.state, self.keys, self.metrics, value
+        )
+        return {"accepted": int(np.sum(np.asarray(accepted)))}
+
     def trace(self, n_ticks: int, cluster: int = 0):
         """Step a single selected cluster with full per-tick info + states captured
         (heavy; debugging only). Does not advance the session."""
@@ -166,6 +187,19 @@ class Session:
 @functools.lru_cache(maxsize=8)
 def _traced_run(cfg: RaftConfig, n_ticks: int):
     return jax.jit(lambda s, k: scan.run(cfg, s, k, n_ticks, trace_states=True))
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _offer_tick(cfg: RaftConfig, state, keys, metrics, value):
+    """One tick with the scheduled client input overridden by `value`
+    (Session.offer), through the SAME shared tick body as the scan loop
+    (scan.tick_batch_minor), so the interactive path can never drift from run()."""
+    from raft_sim_tpu.models import raft_batched
+
+    s_t = raft_batched.to_batch_minor(state)
+    before = metrics.total_cmds
+    s2, metrics = scan.tick_batch_minor(cfg, s_t, keys, metrics, client_cmd=value)
+    return raft_batched.from_batch_minor(s2), metrics, metrics.total_cmds - before
 
 
 _FLAG_TYPES = {"int": int, "float": float}

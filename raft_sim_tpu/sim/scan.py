@@ -157,16 +157,32 @@ def run_batch_minor(
 
     def body(carry, _):
         s, m = carry
-        inp = jax.vmap(lambda k, now: faults.make_inputs(cfg, k, now))(keys, s.now)
-        inp_t = raft_batched.to_batch_minor(inp)
-        s2, info = step_fn(cfg, s, inp_t)
-        m2 = _accumulate(m, info, s.now)  # all fields [B]: elementwise
-        return (s2, m2), None
+        return tick_batch_minor(cfg, s, keys, m, step_fn=step_fn), None
 
     (final_t, metrics), _ = lax.scan(
         body, (s_t, init_metrics_batch(batch)), None, length=n_ticks
     )
     return raft_batched.from_batch_minor(final_t), metrics
+
+
+def tick_batch_minor(cfg, s, keys, metrics, step_fn=None, client_cmd=None):
+    """ONE tick of the batch-minor path: input generation, step, metric
+    accumulation. `s` is batch-minor; `keys` keep their [B]-leading layout (input
+    draws are vmapped batch-leading, then transposed). The single shared tick body
+    for the scan loop above AND interactive single-tick drivers (Session.offer),
+    so the two can never drift. `client_cmd` overrides the scheduled client input
+    for this tick."""
+    from raft_sim_tpu.models import raft_batched
+
+    if step_fn is None:
+        step_fn = raft_batched.step_b
+    inp = jax.vmap(lambda k, now: faults.make_inputs(cfg, k, now))(keys, s.now)
+    if client_cmd is not None:
+        inp = inp._replace(client_cmd=jnp.full_like(inp.client_cmd, client_cmd))
+    inp_t = raft_batched.to_batch_minor(inp)
+    s2, info = step_fn(cfg, s, inp_t)
+    m2 = _accumulate(metrics, info, s.now)  # all fields [B]: elementwise
+    return (s2, m2)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))

@@ -145,3 +145,29 @@ def test_cli_trace_events(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "becomes leader" in out
+
+
+def test_session_offer_interactive_client_write():
+    """Session.offer = the reference's ad-hoc client-set POST: a command offered
+    while leaders exist is accepted, appended with the offered value, and later
+    committed; the offered tick participates in metric accounting like run()."""
+    from raft_sim_tpu import RaftConfig
+    from raft_sim_tpu.driver import Session
+    import numpy as np
+
+    s = Session(RaftConfig(n_nodes=5), batch=8, seed=0)
+    s.run(60)  # elect leaders everywhere (reliable net)
+    r = s.offer(424242)
+    assert r["accepted"] == 8
+    s.run(40)  # let it replicate + commit
+    st = s.state
+    logs = np.asarray(st.log_val)
+    commits = np.asarray(st.commit_index)
+    for c in range(8):
+        lead = int(np.argmax(np.asarray(st.log_len[c])))
+        vals = logs[c, lead, : int(commits[c, lead])]
+        assert 424242 in vals, f"cluster {c}: offered value not committed"
+    assert int(np.asarray(s.metrics.ticks).max()) == 101  # offer tick counted
+    # No leader -> honestly rejected (unlike reference bug 2.3.9's silent hang).
+    s2 = Session(RaftConfig(n_nodes=5), batch=4, seed=1)
+    assert s2.offer(7)["accepted"] == 0  # tick 0: nobody is leader yet
