@@ -148,8 +148,12 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     lcommit = pick_h(mb.req_commit)
     prev_i = jnp.where(has_ae, ws_in + j_in, 0)
     n_ent = jnp.where(has_ae, jnp.clip(pick_h(mb.ent_count) - j_in, 0, e), 0)
-    w_term_in = jnp.sum(jnp.where(sel[:, :, None, :], mb.ent_term[:, None], 0), axis=0)  # [N, E, B]
-    w_val_in = jnp.sum(jnp.where(sel[:, :, None, :], mb.ent_val[:, None], 0), axis=0)
+    # One masked reduction selects BOTH window planes (same one-hot mask): terms
+    # and values ride a single [N, N, 2E, B] pass, split after.
+    ent_tv = jnp.concatenate([mb.ent_term, mb.ent_val], axis=1)  # [N, 2E, B]
+    w_tv = jnp.sum(jnp.where(sel[:, :, None, :], ent_tv[:, None], 0), axis=0)
+    w_term_in = w_tv[:, :e]  # [N, E, B]
+    w_val_in = w_tv[:, e:]
     # prev term via ext[k] = term of 1-based entry ws+k: k=0 is the sender's
     # ent_prev_term, k>=1 the shared window slots; one-hot over the E+1 offsets.
     ext = jnp.concatenate(
