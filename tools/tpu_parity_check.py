@@ -1,0 +1,116 @@
+"""Cross-backend trajectory parity: the accelerator must produce bit-identical
+states and metrics to the CPU backend.
+
+The test suite pins kernel/oracle/batched/sharded parity on CPU (conftest forces
+the CPU platform), so hardware numerics -- int16/int8 arithmetic, uint32 wraparound
+in the commit checksum, reduction orders -- are otherwise only validated indirectly
+(on-device invariants holding during real-chip benches). This script runs the same
+seeded simulations on the default (accelerator) backend and on CPU in a subprocess,
+then compares every non-mailbox state leaf and every metric bit-for-bit.
+
+Usage: python tools/tpu_parity_check.py      # exits nonzero on any mismatch
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+# Runnable from anywhere: the package lives at the repo root (tools/..).
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+CONFIGS = {
+    # name -> (RaftConfig kwargs, seed, batch, ticks)
+    "reliable+client": (dict(n_nodes=5, client_interval=8), 42, 64, 300),
+    "kitchen-sink": (
+        dict(
+            n_nodes=9,
+            log_capacity=16,
+            client_interval=4,
+            drop_prob=0.3,
+            partition_period=32,
+            partition_prob=0.5,
+            crash_prob=0.3,
+            crash_period=40,
+            crash_down_ticks=15,
+            clock_skew_prob=0.1,
+            check_log_matching=True,
+        ),
+        77,
+        32,
+        400,
+    ),
+    "wide-n51": (
+        dict(n_nodes=51, log_capacity=16, partition_period=32, partition_prob=0.5),
+        7,
+        8,
+        200,
+    ),
+}
+
+_CPU_CODE = """
+import json, sys
+sys.path.insert(0, sys.argv[2])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from raft_sim_tpu import RaftConfig
+from raft_sim_tpu.sim import scan
+kwargs, seed, batch, ticks, path = json.loads(sys.argv[1])
+f, m = scan.simulate(RaftConfig(**kwargs), seed, batch, ticks)
+z = np.load(path)
+bad = [k for k, v in zip(f._fields, f) if k != "mailbox"
+       and not np.array_equal(np.asarray(v), z["s_" + k])]
+bad += [k for k, v in zip(m._fields, m)
+        if not np.array_equal(np.asarray(v), z["m_" + k])]
+print(json.dumps(bad))
+"""
+
+
+def main() -> int:
+    import json
+    import tempfile
+
+    import jax
+
+    from raft_sim_tpu import RaftConfig
+    from raft_sim_tpu.sim import scan
+
+    plat = jax.devices()[0].platform
+    if plat == "cpu":
+        print("no accelerator present (platform=cpu); nothing to compare")
+        return 0
+
+    failures = 0
+    for name, (kwargs, seed, batch, ticks) in CONFIGS.items():
+        f, m = scan.simulate(RaftConfig(**kwargs), seed, batch, ticks)
+        with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as tmp:
+            np.savez(
+                tmp.name,
+                **{f"s_{k}": np.asarray(v) for k, v in zip(f._fields, f) if k != "mailbox"},
+                **{f"m_{k}": np.asarray(v) for k, v in zip(m._fields, m)},
+            )
+            arg = json.dumps([kwargs, seed, batch, ticks, tmp.name])
+            r = subprocess.run(
+                [sys.executable, "-c", _CPU_CODE, arg, _ROOT],
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+        if r.returncode != 0:
+            print(f"{name}: CPU subprocess failed:\n{r.stderr[-500:]}")
+            failures += 1
+            continue
+        bad = json.loads(r.stdout.strip().splitlines()[-1])
+        status = f"MISMATCH in {bad}" if bad else "OK"
+        print(f"{name} ({plat} vs cpu): {status}")
+        failures += bool(bad)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
