@@ -88,19 +88,28 @@ def main() -> int:
     failures = 0
     for name, (kwargs, seed, batch, ticks) in CONFIGS.items():
         f, m = scan.simulate(RaftConfig(**kwargs), seed, batch, ticks)
-        with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as tmp:
+        tmp = tempfile.NamedTemporaryFile(suffix=".npz", delete=False)
+        try:
             np.savez(
                 tmp.name,
                 **{f"s_{k}": np.asarray(v) for k, v in zip(f._fields, f) if k != "mailbox"},
                 **{f"m_{k}": np.asarray(v) for k, v in zip(m._fields, m)},
             )
             arg = json.dumps([kwargs, seed, batch, ticks, tmp.name])
-            r = subprocess.run(
-                [sys.executable, "-c", _CPU_CODE, arg, _ROOT],
-                capture_output=True,
-                text=True,
-                timeout=600,
-            )
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", _CPU_CODE, arg, _ROOT],
+                    capture_output=True,
+                    text=True,
+                    timeout=600,
+                )
+            except subprocess.TimeoutExpired:
+                print(f"{name}: CPU subprocess timed out (600s)")
+                failures += 1
+                continue
+        finally:
+            tmp.close()
+            os.unlink(tmp.name)
         if r.returncode != 0:
             print(f"{name}: CPU subprocess failed:\n{r.stderr[-500:]}")
             failures += 1
