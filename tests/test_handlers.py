@@ -613,3 +613,16 @@ def test_stale_append_entries_nacked_with_newer_term():
     assert not resp_ok_of(s2.mailbox, 0, 1)
     assert int(s2.mailbox.resp_term[1]) == 5  # carries the newer term
     assert int(s2.leader_id[1]) == NIL  # stale sender not adopted as leader
+
+
+def test_client_command_rejected_when_log_full():
+    """A leader whose fixed-capacity log is full must drop offered commands (the
+    static-shape analogue of the reference's unbounded vector, SURVEY.md 7.3) --
+    and report the offer as not accepted."""
+    s = with_log(base_state(), 0, [1] * CFG.log_capacity)  # full log
+    s = make_leader(s, 0, 1)
+    inp = quiet_inputs(CFG)._replace(client_cmd=jnp.int32(42))
+    s2, info = step(CFG, s, inp)
+    assert int(s2.log_len[0]) == CFG.log_capacity  # unchanged
+    assert 42 not in np.asarray(s2.log_val[0])
+    assert int(info.cmds_injected) == 0
