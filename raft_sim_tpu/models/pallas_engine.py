@@ -22,7 +22,7 @@ blocked by this image's Mosaic toolchain, not by kernel structure: the original
 int32 tick graph SIGABRTed libtpu at the final compile step (individual phases
 compiled fine), and after the v8 wire format narrowed state to int16/int8 Mosaic
 now rejects it earlier with "Reductions over int16 not implemented". Meanwhile the
-XLA batch-minor path hit 34.8M cluster-ticks/s/chip (config3) with XLA's own
+XLA batch-minor path hit 38.2M cluster-ticks/s/chip (config3) with XLA's own
 fusions, so the headroom a hand-fused kernel could add no longer justifies
 maintaining a second compile path against a toolchain that cannot lower it.
 Revisit if libtpu/Mosaic gains int16 reductions.
