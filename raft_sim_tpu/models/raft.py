@@ -62,6 +62,8 @@ from raft_sim_tpu.types import (
     Mailbox,
     StepInfo,
     StepInputs,
+    pack_resp,
+    unpack_resp,
 )
 from raft_sim_tpu.utils.config import RaftConfig
 
@@ -109,10 +111,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     deliver_req = inp.deliver_mask.T & ~eye & inp.alive[:, None] & dst_up[None, :]
     deliver_resp = inp.deliver_mask & ~eye & dst_up[:, None] & inp.alive[None, :]
     req_in = deliver_req & (mb.req_type != 0)[:, None]  # [sender, receiver]
-    # Unpack the response word (Mailbox docstring: type | ok<<2 | match<<3).
-    r_type = mb.resp_word & 3
-    r_ok = (mb.resp_word >> 2) & 1
-    r_match = mb.resp_word >> 3
+    r_type, r_ok, r_match = unpack_resp(mb.resp_word)
     resp_in = deliver_resp & (r_type != 0)  # [receiver, responder]
 
     # ---- phase 1: term adoption --------------------------------------------------
@@ -376,10 +375,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # round trip, server.clj:59-60 -> client.clj:34-40), packed into one word; the
     # responder's term rides per responder (same value toward every requester).
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_ok = vr_granted | ar_success
-    out_resp_word = (
-        out_resp_type + (out_resp_ok.astype(jnp.int32) << 2) + (ar_match << 3)
-    ).astype(jnp.int16)
+    out_resp_word = pack_resp(
+        out_resp_type, (vr_granted | ar_success).astype(jnp.int32), ar_match
+    )
 
     new_mb = Mailbox(
         req_type=out_req_type,

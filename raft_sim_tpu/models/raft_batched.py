@@ -34,6 +34,8 @@ from raft_sim_tpu.types import (
     Mailbox,
     StepInfo,
     StepInputs,
+    pack_resp,
+    unpack_resp,
 )
 from raft_sim_tpu.utils.config import RaftConfig
 
@@ -91,10 +93,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     )  # [N, N, B]
     deliver_resp = inp.deliver_mask & ~eye3 & dst_up[:, None, :] & inp.alive[None, :, :]
     req_in = deliver_req & (mb.req_type != 0)[:, None, :]
-    # Unpack the response word (Mailbox docstring: type | ok<<2 | match<<3).
-    r_type = mb.resp_word & 3
-    r_ok = (mb.resp_word >> 2) & 1
-    r_match = mb.resp_word >> 3
+    r_type, r_ok, r_match = unpack_resp(mb.resp_word)
     resp_in = deliver_resp & (r_type != 0)
 
     # ---- phase 1: term adoption --------------------------------------------------
@@ -183,9 +182,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     appended_len = jnp.minimum(prev_i + n_ent, cap)
     new_len = jnp.where(any_mismatch, appended_len, jnp.maximum(s.log_len, appended_len))
     log_len = jnp.where(ae_ok, new_len, s.log_len)
-    wmask = ae_ok[:, None, :] & in_ent
-    log_term_arr = log_ops.write_window_b(s.log_term, prev_i, ent_term_in, wmask)
-    log_val_arr = log_ops.write_window_b(s.log_val, prev_i, ent_val_in, wmask)
+    log_term_arr = log_ops.write_window_b(s.log_term, prev_i, ent_term_in, ae_ok, n_ent)
+    log_val_arr = log_ops.write_window_b(s.log_val, prev_i, ent_val_in, ae_ok, n_ent)
 
     last_new = jnp.minimum(prev_i + n_ent, log_len)
     commit = jnp.where(
@@ -327,9 +325,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     # transpose-free and now also broadcast-free: nothing [N, N]-shaped is written
     # beyond the offset and response planes.
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_ok = (vr_granted | ar_success).astype(jnp.int32)
-    out_resp_word = (out_resp_type + (out_resp_ok << 2) + (ar_match << 3)).astype(
-        jnp.int16
+    out_resp_word = pack_resp(
+        out_resp_type, (vr_granted | ar_success).astype(jnp.int32), ar_match
     )
 
     new_mb = Mailbox(

@@ -207,26 +207,28 @@ def write_window_b(
     arr: jax.Array,
     start0: jax.Array,
     vals: jax.Array,
-    mask: jax.Array,
+    gate: jax.Array,
+    count: jax.Array,
 ) -> jax.Array:
-    """Batched write_window. arr: [N, CAP, B]; start0: [N, B]; vals/mask: [N, E, B].
+    """Batched write_window, restricted to the contiguous-prefix writes the kernels
+    actually do: where `gate[n, b]`, write vals[n, k, b] into arr[n, start0 + k, b]
+    for k < count[n, b]. arr: [N, CAP, B]; start0/gate/count: [N, B]; vals: [N, E, B].
 
-    Window positions are strictly increasing in k, so each capacity slot is hit by at
-    most one unmasked entry; masked entries are routed to position `cap`, which matches
-    no slot (the scatter form's mode='drop').
-
-    PRECONDITION (unlike the general scatter form): `mask` must be a contiguous
-    prefix along E -- mask[n, k, b] == gate[n, b] & (k < count[n, b]) -- which is
-    what every kernel write site produces; the written-slot test below relies on
-    it."""
+    Taking (gate, count) instead of a free-form [N, E, B] mask makes the old
+    implicit precondition (mask must be a contiguous prefix along E) structural:
+    the written-slot test below is two compares against [start0, start0 + count)
+    instead of an E-way any-reduce, and no caller can pass a mask shape it would
+    silently mis-handle. Window positions are strictly increasing in k, so each
+    capacity slot is hit by at most one written entry; out-of-range entries are
+    routed to position `cap`, which matches no slot (the scatter form's
+    mode='drop')."""
     cap = arr.shape[1]
-    pos = start0[:, None, :] + iota((1, vals.shape[1], 1), 1)  # [N, E, B]
+    e = vals.shape[1]
+    count = jnp.minimum(jnp.where(gate, count, 0), e).astype(jnp.int32)  # [N, B]
+    mask = iota((1, e, 1), 1) < count[:, None, :]  # [N, E, B]; count is 0 where ~gate
+    pos = start0[:, None, :] + iota((1, e, 1), 1)  # [N, E, B]
     pos = jnp.where(mask, pos, cap)
     oh = iota((1, 1, cap, 1), 2) == pos[:, :, None, :]  # [N, E, CAP, B]
-    # The kernel's write masks are always contiguous prefixes (mask = gate & (k <
-    # n_ent)), so the positions form the range [start0, start0 + count) and the
-    # written-slot test is two compares instead of an E-way any-reduce over `oh`.
-    count = jnp.sum(mask, axis=1).astype(jnp.int32)  # [N, B]
     cs = iota((1, cap, 1), 1)
     hit = (cs >= start0[:, None, :]) & (cs < (start0 + count)[:, None, :])
     val = jnp.sum(jnp.where(oh, vals[:, :, None, :], 0), axis=1)

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 # ACK_AGE_SAT is re-exported here because the kernels read it alongside
 # ClusterState; it lives in config (the leaf module) for the validator.
-from raft_sim_tpu.utils.config import ACK_AGE_SAT, RaftConfig
+from raft_sim_tpu.utils.config import ACK_AGE_SAT, MAX_LOG_CAPACITY, RaftConfig
 from raft_sim_tpu.utils.rng import draw_timeouts
 
 # Node roles (reference keywords :follower/:candidate/:leader, core.clj:31-38;
@@ -49,6 +49,33 @@ RESP_VOTE = 1  # :vote-response
 RESP_APPEND = 2  # :append-response
 
 NIL = -1  # nil node id
+
+# Packed response word (Mailbox.resp_word): type (2 bits) | ok << 2 | match << 3.
+# Both kernels and the checkpoint format share this layout through pack_resp/
+# unpack_resp below; tests/oracle.py re-derives it independently and
+# tests/test_constants.py pins the two against each other.
+RESP_TYPE_MASK = 3
+RESP_OK_SHIFT = 2
+RESP_MATCH_SHIFT = 3
+# Static bit-budget tie: resp_word is int16, so after 2 type bits + 1 ok bit the
+# packed match index gets 12 value bits + nothing to spare above the sign bit.
+# The largest packable match is the log-capacity ceiling enforced at config
+# construction -- the packing sits at exactly that limit, asserted here so
+# widening MAX_LOG_CAPACITY without widening resp_word is an import-time error.
+assert (MAX_LOG_CAPACITY << RESP_MATCH_SHIFT) + (1 << RESP_OK_SHIFT) + RESP_TYPE_MASK < 2**15
+
+
+def pack_resp(rtype, ok, match):
+    """Pack (type, ok, match) into the int16 response word. `ok` must be 0/1 int,
+    `match` a log index in [0, MAX_LOG_CAPACITY]."""
+    return (rtype + (ok << RESP_OK_SHIFT) + (match << RESP_MATCH_SHIFT)).astype(
+        jnp.int16
+    )
+
+
+def unpack_resp(word):
+    """(type, ok, match) from a response word. Works on jnp and numpy arrays."""
+    return word & RESP_TYPE_MASK, (word >> RESP_OK_SHIFT) & 1, word >> RESP_MATCH_SHIFT
 
 
 class Mailbox(NamedTuple):

@@ -23,6 +23,12 @@ import dataclasses
 # config validator needs it and config is the leaf module.
 ACK_AGE_SAT = 30000
 
+# Upper bound on RaftConfig.log_capacity. Log indices ride int16 state planes
+# (ClusterState.next_index/match_index) and the packed response word gives the
+# acked log index exactly 12 value bits above its 3 flag bits (types.pack_resp,
+# which statically asserts this bound fits that budget -- the two are tied there).
+MAX_LOG_CAPACITY = 4095
+
 
 @dataclasses.dataclass(frozen=True)
 class RaftConfig:
@@ -95,7 +101,7 @@ class RaftConfig:
         # Narrow-dtype wire/state bounds (types.py): log indices ride int16 planes
         # (next/match, and the packed response word spends 13 bits on match), the
         # AE window offset rides int8, and ack ages saturate below int16 max.
-        assert 1 <= self.log_capacity <= 4095
+        assert 1 <= self.log_capacity <= MAX_LOG_CAPACITY
         assert 1 <= self.max_entries_per_rpc <= min(self.log_capacity, 127)
         assert self.ack_timeout_ticks < ACK_AGE_SAT
         assert self.heartbeat_ticks >= 1

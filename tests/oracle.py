@@ -22,7 +22,29 @@ FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 REQ_NONE, REQ_VOTE, REQ_APPEND = 0, 1, 2
 RESP_NONE, RESP_VOTE, RESP_APPEND = 0, 1, 2
 NIL = -1
-ACK_AGE_SAT = 30000  # keep in sync with raft_sim_tpu.types.ACK_AGE_SAT
+# Independently-stated copies of the implementation's constants (the oracle must not
+# import from raft_sim_tpu); tests/test_constants.py pins them against the originals
+# so they cannot drift silently.
+ACK_AGE_SAT = 30000  # raft_sim_tpu.utils.config.ACK_AGE_SAT
+
+
+def chk_weights(k: int) -> tuple[int, int]:
+    """(term weight, value weight) of 0-based log slot k for the committed-prefix
+    checksum -- the oracle's statement of log_ops.chk_weights (mod 2^32)."""
+    m = (1 << 32) - 1
+    w_t = ((k * 2654435761 + 0x9E3779B9) | 1) & m
+    w_v = ((k * 0x85EBCA77 + 0xC2B2AE3D) | 1) & m
+    return w_t, w_v
+
+
+def pack_resp(rtype: int, ok: int, match: int) -> int:
+    """The packed response word -- the oracle's statement of types.pack_resp."""
+    return rtype + (ok << 2) + (match << 3)
+
+
+def unpack_resp(word):
+    """(type, ok, match) -- the oracle's statement of types.unpack_resp."""
+    return word & 3, (word >> 2) & 1, word >> 3
 
 
 def state_to_dict(state) -> dict:
@@ -90,9 +112,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     np.fill_diagonal(edge_ok, False)
     recv_up = alive & ~restarted
     req_in = edge_ok.T & alive[:, None] & recv_up[None, :] & (mb["req_type"] != 0)[:, None]
-    r_type = mb["resp_word"] & 3
-    r_ok = (mb["resp_word"] >> 2) & 1
-    r_match = mb["resp_word"] >> 3
+    r_type, r_ok, r_match = unpack_resp(mb["resp_word"])
     resp_in = edge_ok & recv_up[:, None] & alive[None, :] & (r_type != 0)
 
     # ---- phase 1: term adoption
@@ -294,14 +314,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, d] = True
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
 
-    # ---- committed-prefix checksum (log_ops.chk_weights -- keep formula in sync)
+    # ---- committed-prefix checksum (log_ops.chk_weights analogue, chk_weights above)
     if cfg.check_invariants:
         M = (1 << 32) - 1
         for d in range(n):
             acc = 0
             for k in range(int(commit[d])):
-                w_t = ((k * 2654435761 + 0x9E3779B9) | 1) & M
-                w_v = ((k * 0x85EBCA77 + 0xC2B2AE3D) | 1) & M
+                w_t, w_v = chk_weights(k)
                 acc = (acc + int(log_term[d, k]) * w_t + int(log_val[d, k]) * w_v) & M
             commit_chk[d] = np.uint32(acc)
 
@@ -371,7 +390,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 rtype += RESP_APPEND
             if rtype:
                 ok = int(bool(vr_granted[r, q] or ar_success[r, q]))
-                out["resp_word"][q, r] = rtype + (ok << 2) + (int(ar_match[r, q]) << 3)
+                out["resp_word"][q, r] = pack_resp(rtype, ok, int(ar_match[r, q]))
 
     return {
         "role": role,

@@ -1,0 +1,44 @@
+"""Drift tests: the oracle re-states several implementation constants in its own
+words (tests/oracle.py must stay import-independent of raft_sim_tpu so it is a real
+second implementation). These tests pin each restated constant/formula to the
+original, so an update to one side without the other fails loudly instead of
+surfacing as a mystery parity diff."""
+
+import numpy as np
+
+from raft_sim_tpu import types
+from raft_sim_tpu.ops import log_ops
+from raft_sim_tpu.utils import config
+from tests import oracle
+
+
+def test_ack_age_sat_matches():
+    assert oracle.ACK_AGE_SAT == config.ACK_AGE_SAT == types.ACK_AGE_SAT
+
+
+def test_chk_weights_match():
+    cap = 64
+    w_t, w_v = log_ops.chk_weights(cap)
+    want = np.array([oracle.chk_weights(k) for k in range(cap)], dtype=np.uint32)
+    np.testing.assert_array_equal(np.asarray(w_t), want[:, 0])
+    np.testing.assert_array_equal(np.asarray(w_v), want[:, 1])
+
+
+def test_pack_resp_matches():
+    import jax.numpy as jnp
+
+    samples = [
+        (rtype, ok, match)
+        for rtype in (0, 1, 2, 3)
+        for ok in (0, 1)
+        for match in (0, 1, 7, 2047, config.MAX_LOG_CAPACITY)
+    ]
+    for rtype, ok, match in samples:
+        want = oracle.pack_resp(rtype, ok, match)
+        got = types.pack_resp(
+            jnp.int32(rtype), jnp.int32(ok), jnp.int32(match)
+        )
+        assert int(got) == np.int16(want), (rtype, ok, match)
+        for unpack in (types.unpack_resp, oracle.unpack_resp):
+            rt, o, m = unpack(np.int16(want))
+            assert (int(rt), int(o), int(m)) == (rtype, ok, match)

@@ -87,23 +87,26 @@ def rv_wire(s, src, term, last_idx=0, last_term=0):
 
 def resp_wire(s, q, r, rtype, term, ok, match=0):
     """Wire a response from responder `r` to requester `q`."""
+    word = raft_types.pack_resp(
+        jnp.int32(rtype), jnp.int32(int(ok)), jnp.int32(match)
+    )
     mb = s.mailbox._replace(
-        resp_word=s.mailbox.resp_word.at[q, r].set(rtype + (int(ok) << 2) + (match << 3)),
+        resp_word=s.mailbox.resp_word.at[q, r].set(word),
         resp_term=s.mailbox.resp_term.at[r].set(term),
     )
     return s._replace(mailbox=mb)
 
 
 def resp_type_of(mb, q, r):
-    return int(mb.resp_word[q, r]) & 3
+    return int(raft_types.unpack_resp(mb.resp_word[q, r])[0])
 
 
 def resp_ok_of(mb, q, r):
-    return bool((int(mb.resp_word[q, r]) >> 2) & 1)
+    return bool(int(raft_types.unpack_resp(mb.resp_word[q, r])[1]))
 
 
 def resp_match_of(mb, q, r):
-    return int(mb.resp_word[q, r]) >> 3
+    return int(raft_types.unpack_resp(mb.resp_word[q, r])[2])
 
 
 # ---------------------------------------------------------------- RequestVote handling
