@@ -375,9 +375,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # round trip, server.clj:59-60 -> client.clj:34-40), packed into one word; the
     # responder's term rides per responder (same value toward every requester).
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_word = pack_resp(
-        out_resp_type, (vr_granted | ar_success).astype(jnp.int32), ar_match
-    )
+    out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match)
 
     new_mb = Mailbox(
         req_type=out_req_type,

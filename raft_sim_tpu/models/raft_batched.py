@@ -325,9 +325,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     # transpose-free and now also broadcast-free: nothing [N, N]-shaped is written
     # beyond the offset and response planes.
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_word = pack_resp(
-        out_resp_type, (vr_granted | ar_success).astype(jnp.int32), ar_match
-    )
+    out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match)
 
     new_mb = Mailbox(
         req_type=out_req_type,

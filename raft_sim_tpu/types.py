@@ -66,8 +66,9 @@ assert (MAX_LOG_CAPACITY << RESP_MATCH_SHIFT) + (1 << RESP_OK_SHIFT) + RESP_TYPE
 
 
 def pack_resp(rtype, ok, match):
-    """Pack (type, ok, match) into the int16 response word. `ok` must be 0/1 int,
-    `match` a log index in [0, MAX_LOG_CAPACITY]."""
+    """Pack (type, ok, match) into the int16 response word. `ok` may be bool or
+    0/1 int; `match` is a log index in [0, MAX_LOG_CAPACITY]."""
+    ok = jnp.asarray(ok).astype(jnp.int32)
     return (rtype + (ok << RESP_OK_SHIFT) + (match << RESP_MATCH_SHIFT)).astype(
         jnp.int16
     )

@@ -99,7 +99,7 @@ class RaftConfig:
     def __post_init__(self):
         assert self.n_nodes >= 2
         # Narrow-dtype wire/state bounds (types.py): log indices ride int16 planes
-        # (next/match, and the packed response word spends 13 bits on match), the
+        # (next/match, and the packed response word gives match 12 value bits), the
         # AE window offset rides int8, and ack ages saturate below int16 max.
         assert 1 <= self.log_capacity <= MAX_LOG_CAPACITY
         assert 1 <= self.max_entries_per_rpc <= min(self.log_capacity, 127)

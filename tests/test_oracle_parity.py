@@ -86,18 +86,66 @@ CONFIGS = [
 ]
 
 
-@pytest.mark.parametrize("cfg,seed", CONFIGS)
-def test_trajectory_parity(cfg, seed):
-    key = jax.random.key(seed)
-    k_init, k_run = jax.random.split(key)
-    state = init_state(cfg, k_init)
+def run_parity(cfg, state, k_run, ticks):
     step = jax.jit(lambda s, i: raft.step(cfg, s, i)[0])
-
     s_oracle = oracle.state_to_dict(state)
-    ticks = 150
     for t in range(ticks):
         inp = faults.make_inputs(cfg, k_run, state.now)
         inp_np = {f: np.asarray(v) for f, v in zip(inp._fields, inp)}
         state = step(state, inp)
         s_oracle = oracle.oracle_step(cfg, s_oracle, inp_np)
         assert_state_equal(oracle.state_to_dict(state), s_oracle, t)
+    return state
+
+
+@pytest.mark.parametrize("cfg,seed", CONFIGS)
+def test_trajectory_parity(cfg, seed):
+    key = jax.random.key(seed)
+    k_init, k_run = jax.random.split(key)
+    run_parity(cfg, init_state(cfg, k_init), k_run, ticks=150)
+
+
+def test_parity_at_int16_index_boundary():
+    """CAP-scale log indices riding the narrow planes: next/match (int16) and the
+    packed response word's 12-bit match field near its MAX_LOG_CAPACITY = 4095
+    ceiling. The small-CAP rows above never push an index past 8; here every node
+    starts with ~3980 committed-prefix entries, so election bookkeeping, append
+    acks, and capacity rejection all run with indices in the 3980..4095 range --
+    checked against the oracle bit-for-bit, including commit_chk over the 3970-deep
+    prefix."""
+    import jax.numpy as jnp
+
+    from raft_sim_tpu.types import with_commit_chk
+    from raft_sim_tpu.utils.config import MAX_LOG_CAPACITY
+
+    cfg = RaftConfig(
+        n_nodes=5,
+        log_capacity=MAX_LOG_CAPACITY,
+        max_entries_per_rpc=8,
+        client_interval=1,
+    )
+    key = jax.random.key(6)
+    k_init, k_run = jax.random.split(key)
+    state = init_state(cfg, k_init)
+
+    # Identical 3980-entry term-1 logs on every node, 3970 of them committed.
+    pre = 3980
+    n = cfg.n_nodes
+    lt = state.log_term.at[:, :pre].set(1)
+    lv = state.log_val.at[:, :pre].set(
+        jnp.broadcast_to(jnp.arange(pre, dtype=jnp.int32), (n, pre))
+    )
+    state = with_commit_chk(
+        state._replace(
+            log_term=lt,
+            log_val=lv,
+            log_len=jnp.full((n,), pre, jnp.int32),
+            commit_index=jnp.full((n,), pre - 10, jnp.int32),
+        )
+    )
+
+    final = run_parity(cfg, state, k_run, ticks=60)
+    # The run must actually have driven indices past the prefill: a leader exists
+    # and appended client commands toward the capacity ceiling.
+    assert int(np.max(np.asarray(final.log_len))) > pre
+    assert int(np.max(np.asarray(final.match_index))) > pre - 10
