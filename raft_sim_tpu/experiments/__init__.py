@@ -1,0 +1,5 @@
+"""Parked experimental engines -- real, parity-tested code whose production use is
+blocked by toolchain limits, kept out of the supported `models/` surface.
+
+Currently: `pallas_engine` (the whole tick as one fused pallas_call; interpret-only
+until this image's Mosaic gains int16 reductions -- see docs/DESIGN.md)."""

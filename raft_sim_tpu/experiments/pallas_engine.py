@@ -10,22 +10,25 @@ possible HBM traffic per tick.
 Because `step_b` is pure jnp on batch-minor arrays, the kernel body simply *calls it*
 on values read from the block refs: there is no duplicated protocol logic, so the
 bit-parity chain (oracle -> raft.py -> raft_batched.py) extends to this engine for
-free, and tests/test_pallas.py pins it (interpret mode on CPU, compiled on TPU).
+free, and tests/test_pallas.py pins it (interpret mode on CPU; the compiled TPU
+path is toolchain-blocked, see STATUS below).
 
 Shape handling: TPU Pallas wants >=2-D refs, so rank-1 leaves ([B]-shaped: state.now,
 client_cmd, and every StepInfo field) cross the boundary as [1, B].
 
-STATUS — PARKED (decision, round 2; see docs/DESIGN.md "Pallas engine"): interpret
-mode (CPU) works and is parity-tested every run (tests/test_pallas.py), which pins
-that the tick kernel remains pallas_call-compatible. The compiled TPU path is
-blocked by this image's Mosaic toolchain, not by kernel structure: the original
-int32 tick graph SIGABRTed libtpu at the final compile step (individual phases
-compiled fine), and after the v8 wire format narrowed state to int16/int8 Mosaic
-now rejects it earlier with "Reductions over int16 not implemented". Meanwhile the
-XLA batch-minor path hit 38.2M cluster-ticks/s/chip (config3) with XLA's own
-fusions, so the headroom a hand-fused kernel could add no longer justifies
-maintaining a second compile path against a toolchain that cannot lower it.
-Revisit if libtpu/Mosaic gains int16 reductions.
+STATUS — EXPERIMENTAL (demoted from models/ in round 4; see docs/DESIGN.md "Pallas
+engine"): interpret mode (CPU) works and is parity-tested every run
+(tests/test_pallas.py), which pins that the tick kernel remains
+pallas_call-compatible. The compiled TPU path is blocked by this image's Mosaic
+toolchain, not by kernel structure: the original int32 tick graph SIGABRTed libtpu
+at the final compile step (individual phases compiled fine), and after the v8 wire
+format narrowed state to int16/int8 Mosaic rejects it earlier with "Reductions
+over int16 not implemented" -- re-confirmed on the real chip in round 4, which
+triggered the demotion round 2's park decision called for. Meanwhile the XLA
+batch-minor path hit 38.2M cluster-ticks/s/chip (config3) with XLA's own fusions,
+so the headroom a hand-fused kernel could add no longer justifies maintaining a
+second compile path against a toolchain that cannot lower it. Revisit if
+libtpu/Mosaic gains int16 reductions.
 """
 
 from __future__ import annotations

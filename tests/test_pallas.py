@@ -3,9 +3,10 @@
 The Pallas engine runs the identical step_b body inside a pallas_call gridded over
 cluster blocks, so parity here extends the oracle -> raft.py -> raft_batched.py chain
 to the kernelized execution path. On this image's TPU toolchain the compiled path is
-blocked by a compiler crash (see models/pallas_engine.py docstring); interpret mode
-exercises the full pallas_call machinery (blocking, ref plumbing, shape lifting) on
-CPU.
+blocked by a compiler limitation, which demoted the engine to experiments/ (see
+experiments/pallas_engine.py docstring); interpret mode exercises the full
+pallas_call machinery (blocking, ref plumbing, shape lifting) on CPU and keeps the
+tick kernel pallas-compatible for the day the toolchain can lower it.
 """
 
 import jax
@@ -13,7 +14,8 @@ import numpy as np
 import pytest
 
 from raft_sim_tpu import RaftConfig, init_batch
-from raft_sim_tpu.models import pallas_engine, raft_batched
+from raft_sim_tpu.experiments import pallas_engine
+from raft_sim_tpu.models import raft_batched
 from raft_sim_tpu.sim import faults, scan
 
 
