@@ -134,10 +134,12 @@ class Session:
         unlike the reference's never-firing commit watch, bug 2.3.9).
         """
         value = int(value)
-        from raft_sim_tpu.types import NIL
+        from raft_sim_tpu.types import NIL, NOOP
 
-        if value == NIL:
-            raise ValueError(f"command value {NIL} collides with the NIL sentinel")
+        if value in (NIL, NOOP):
+            raise ValueError(
+                f"command value {value} collides with the NIL/NOOP sentinels"
+            )
         if not -(2**31) <= value < 2**31:
             raise ValueError(f"command value must fit int32, got {value}")
         self.state, self.metrics, accepted = _offer_tick(

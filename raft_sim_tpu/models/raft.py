@@ -18,7 +18,14 @@ SURVEY.md section 2.3 and deliberately NOT carried):
   phase 3  append requests       <- append-entries-handler (core.clj:105-123), with
                                     spec conflict-truncate-then-append instead of the
                                     remove-from! bug (2.3.7) and real leader-commit
-                                    handling instead of apply-everything (2.3.6)
+                                    handling instead of apply-everything (2.3.6);
+                                    under compaction also the InstallSnapshot
+                                    analogue (req_off == -1 edges install the
+                                    sender's base/base_term/base_chk)
+  phase 5.5 log compaction       <- absent in the reference (its log vector is
+                                    unbounded, log.clj:33); the ring must free
+                                    committed slots so client workloads never
+                                    exhaust the fixed-capacity arrays
   phase 4  responses             <- vote-response-handler (core.clj:125-139) and
                                     append-response-handler (core.clj:141-149), with
                                     next-index = match+1 (bug 2.3.10)
@@ -54,6 +61,7 @@ from raft_sim_tpu.types import (
     FOLLOWER,
     LEADER,
     NIL,
+    NOOP,
     REQ_APPEND,
     REQ_VOTE,
     RESP_APPEND,
@@ -71,17 +79,20 @@ from raft_sim_tpu.utils.config import RaftConfig
 def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
     """Advance one cluster by one tick. Pure; jit/vmap/scan-safe."""
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
+    comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
     ids = jnp.arange(n, dtype=jnp.int32)
     eye = jnp.eye(n, dtype=bool)
     snd_ids = jnp.broadcast_to(ids[:, None], (n, n))  # [sender, receiver] -> sender id
 
     # ---- phase -1: restart (crash fault) -----------------------------------------
     # A node restarting this tick rejoins as a fresh follower: the Raft persistent
-    # triple (currentTerm, votedFor, log[]) survives, everything else is volatile and
-    # wiped (Raft fig. 2 state table). The reference instead persists only committed
-    # values (log.clj:16-18), so its restarted process forgets term/vote -- bug
-    # 2.3.12, deliberately not carried. Wiping commitIndex here (before `old` is
-    # captured for phase 9) keeps the monotonic-commit invariant meaningful.
+    # triple (currentTerm, votedFor, log[]) survives -- including the snapshot
+    # (log_base/base_term/base_chk), so commitIndex resumes at log_base, the
+    # durable applied prefix -- everything else is volatile and wiped (Raft fig. 2
+    # state table). The reference instead persists only committed values
+    # (log.clj:16-18), so its restarted process forgets term/vote -- bug 2.3.12,
+    # deliberately not carried. Wiping commitIndex here (before `old` is captured
+    # for phase 9) keeps the monotonic-commit invariant meaningful.
     rs = inp.restarted
     s = s._replace(
         role=jnp.where(rs, FOLLOWER, s.role),
@@ -90,11 +101,12 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         next_index=jnp.where(rs[:, None], 1, s.next_index),
         match_index=jnp.where(rs[:, None], 0, s.match_index),
         ack_age=jnp.where(rs[:, None], ACK_AGE_SAT, s.ack_age),
-        commit_index=jnp.where(rs, 0, s.commit_index),
-        commit_chk=jnp.where(rs, jnp.uint32(0), s.commit_chk),
+        commit_index=jnp.where(rs, s.log_base, s.commit_index),
+        commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
     mb = s.mailbox
+    base, bterm, bchk = s.log_base, s.base_term, s.base_chk
 
     # ---- phase 0: delivery -------------------------------------------------------
     # The fault mask is the TPU-native form of the reference's silently-dropped HTTP
@@ -129,7 +141,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     leader_id = jnp.where(saw_higher, NIL, s.leader_id)
     votes = s.votes & ~saw_higher[:, None]
 
-    my_last_idx, my_last_term = log_ops.last_index_term(s.log_term, s.log_len)
+    if comp:
+        my_last_idx = s.log_len
+        my_last_term = log_ops.term_at_r(s.log_term, base, bterm, s.log_len)
+    else:
+        my_last_idx, my_last_term = log_ops.last_index_term(s.log_term, s.log_len)
 
     # ---- phase 2: RequestVote requests (request-vote-handler, core.clj:91-103) ----
     is_rv = req_in & (mb.req_type == REQ_VOTE)[:, None]  # [candidate, voter]
@@ -171,18 +187,25 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # selected everything is zeroed/garbage but gated by has_ae/ae_ok downstream.
     j_in = jnp.sum(jnp.where(sel, mb.req_off, 0), axis=0).astype(jnp.int32)  # [N] in 0..E
     sel_idx = jnp.minimum(ae_src, n - 1)
+    # InstallSnapshot analogue (compaction only): offset sentinel -1 means "install
+    # my compaction base instead of entries" -- sent when this peer's next_index
+    # fell below the leader's log_base (phase 8), the array form of Raft fig. 13.
+    # The reference can never need this (its log is unbounded, core.clj:59-67).
+    snap = (has_ae & (j_in < 0)) if comp else jnp.zeros((n,), bool)
+    ae_norm = has_ae & ~snap
+    j_nn = jnp.clip(j_in, 0, e)  # snap's -1 routed to 0; gated by ae_norm downstream
     ws_in = mb.ent_start[sel_idx]  # [N]
     w_term = mb.ent_term[sel_idx]  # [N, E]
     w_val = mb.ent_val[sel_idx]
-    prev_i = jnp.where(has_ae, ws_in + j_in, 0)
-    lcommit = jnp.where(has_ae, mb.req_commit[sel_idx], 0)
-    n_ent = jnp.where(has_ae, jnp.clip(mb.ent_count[sel_idx] - j_in, 0, e), 0)
+    prev_i = jnp.where(ae_norm, ws_in + j_nn, 0)
+    lcommit = jnp.where(ae_norm, mb.req_commit[sel_idx], 0)
+    n_ent = jnp.where(ae_norm, jnp.clip(mb.ent_count[sel_idx] - j_nn, 0, e), 0)
     # prev term: the window slot just before this receiver's entries (j-1), or the
     # sender's ent_prev_term for j == 0 -- ext[k] = term of 1-based entry ws+k.
     ext = jnp.concatenate([mb.ent_prev_term[sel_idx][:, None], w_term], axis=1)
-    prev_t = jnp.take_along_axis(ext, j_in[:, None], axis=1)[:, 0]  # [N]
+    prev_t = jnp.take_along_axis(ext, j_nn[:, None], axis=1)[:, 0]  # [N]
     # This receiver's entries start at window slot j (slot k holds entry ws+k+1).
-    off = jnp.clip(j_in, 0, e - 1)  # j = E only when n_ent = 0 (fully masked)
+    off = jnp.clip(j_nn, 0, e - 1)  # j = E only when n_ent = 0 (fully masked)
     ent_term_in = log_ops.window(w_term, off, e)  # [N, E]
     ent_val_in = log_ops.window(w_val, off, e)
 
@@ -192,43 +215,114 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     leader_id = jnp.where(has_ae, ae_src, leader_id)
 
     # Consistency check (spec 5.3; reference compare-prev? has bugs 2.3.4/2.3.5).
-    prev_stored_term = log_ops.term_at(s.log_term, prev_i)
-    consistent = (prev_i == 0) | ((prev_i <= s.log_len) & (prev_stored_term == prev_t))
-    ae_ok = has_ae & consistent
+    if comp:
+        # prev below the local base is committed-and-compacted: it matches by
+        # leader completeness (a current-term leader's log holds every committed
+        # entry); at prev == base, term_at_r yields base_term -- the snapshot
+        # boundary check.
+        prev_stored_term = log_ops.term_at_r(s.log_term, base, bterm, prev_i)
+        consistent = (
+            (prev_i == 0)
+            | (prev_i < base)
+            | ((prev_i <= s.log_len) & (prev_stored_term == prev_t))
+        )
+    else:
+        prev_stored_term = log_ops.term_at(s.log_term, prev_i)
+        consistent = (prev_i == 0) | (
+            (prev_i <= s.log_len) & (prev_stored_term == prev_t)
+        )
+    ae_ok = ae_norm & consistent
 
     # Conflict scan over the shipped window: first mismatching entry truncates the rest
     # of the log; matching prefixes are never truncated (spec 5.3 "delete the existing
     # entry and all that follow it").
     ks = jnp.arange(e, dtype=jnp.int32)
-    gidx0 = prev_i[:, None] + ks[None, :]  # [N, E] 0-based slots
-    in_ent = ks[None, :] < n_ent[:, None]
+    gidx0 = prev_i[:, None] + ks[None, :]  # [N, E] 0-based entry indices
+    if comp:
+        # Skip entries the ring already compacted (abs index <= base) and accept
+        # only what it can hold (entries past base + CAP would evict live,
+        # un-compacted slots; the partial ack makes the leader retry the rest
+        # after this node's own commit+compaction frees room).
+        lo = jnp.clip(base - prev_i, 0, e)  # [N]
+        n_acc = jnp.minimum(n_ent, jnp.maximum(base + cap - prev_i, 0))
+        in_ent = (ks[None, :] >= lo[:, None]) & (ks[None, :] < n_acc[:, None])
+        stored = log_ops.window_r(s.log_term, prev_i, e)  # [N, E]
+        appended_len = prev_i + n_acc
+    else:
+        n_acc = n_ent
+        in_ent = ks[None, :] < n_ent[:, None]
+        stored = log_ops.window(s.log_term, prev_i, e)  # [N, E]
+        appended_len = jnp.minimum(prev_i + n_ent, cap)
     exists = gidx0 < s.log_len[:, None]
-    stored = log_ops.window(s.log_term, prev_i, e)  # [N, E]
     mismatch = in_ent & exists & (stored != ent_term_in)
     any_mismatch = jnp.any(mismatch, axis=1)
-    appended_len = jnp.minimum(prev_i + n_ent, cap)
     new_len = jnp.where(
         any_mismatch, appended_len, jnp.maximum(s.log_len, appended_len)
     )
     log_len = jnp.where(ae_ok, new_len, s.log_len)
     wmask = ae_ok[:, None] & in_ent
-    log_term_arr = log_ops.write_window(s.log_term, prev_i, ent_term_in, wmask)
-    log_val_arr = log_ops.write_window(s.log_val, prev_i, ent_val_in, wmask)
+    if comp:
+        log_term_arr = log_ops.write_window_r(s.log_term, prev_i, ent_term_in, wmask)
+        log_val_arr = log_ops.write_window_r(s.log_val, prev_i, ent_val_in, wmask)
+    else:
+        log_term_arr = log_ops.write_window(s.log_term, prev_i, ent_term_in, wmask)
+        log_val_arr = log_ops.write_window(s.log_val, prev_i, ent_val_in, wmask)
 
     # Follower commit: min(leaderCommit, index of last new entry), monotonic
     # (the reference's apply-entries! commits everything unconditionally, bug 2.3.6).
-    last_new = jnp.minimum(prev_i + n_ent, log_len)
+    last_new = jnp.minimum(prev_i + n_acc, log_len)
     commit = jnp.where(
         ae_ok,
         jnp.maximum(s.commit_index, jnp.minimum(lcommit, last_new)),
         s.commit_index,
     )
 
-    # Respond to every delivered AE; success only for the selected, consistent one.
+    # Snapshot install (compaction only). L <= base needs nothing (we already hold
+    # that prefix -- plain ack); otherwise, if our log extends through L with the
+    # snapshot's term, retain the suffix (Raft fig. 13 rule 6), else discard the
+    # whole log. Either way our compaction state becomes the leader's and commit
+    # advances to at least L (everything below a snapshot is committed).
+    if comp:
+        L = jnp.where(snap, mb.req_base[sel_idx], 0)
+        Lt = mb.req_base_term[sel_idx]
+        Lchk = mb.req_base_chk[sel_idx]
+        apply_snap = snap & (L > base)
+        keep = (
+            apply_snap
+            & (L <= s.log_len)
+            & (log_ops.term_at_r(s.log_term, base, bterm, L) == Lt)
+        )
+        wipe = apply_snap & ~keep
+        bterm = jnp.where(apply_snap, Lt, bterm)
+        bchk = jnp.where(apply_snap, Lchk, bchk)
+        base = jnp.where(apply_snap, L, base)
+        log_len = jnp.where(wipe, L, log_len)
+        commit = jnp.where(apply_snap, jnp.maximum(commit, L), commit)
+    else:
+        apply_snap = jnp.zeros((n,), bool)
+
+    # Respond to every delivered AE; success only for the selected, consistent one
+    # (snapshot installs always ack, with match = the snapshot index). A NACK
+    # carries the responder's log length as a catch-up hint: the leader jumps
+    # next_index straight to hint+1 instead of decrementing once per heartbeat --
+    # the standard conflict-index optimization (Raft paper section 5.3 "the
+    # protocol can be optimized"). Without it a freshly elected leader walks next
+    # down 1 per nack while client traffic grows its log ~1 per tick, and under
+    # recurring crash churn no current-term entry ever reaches quorum (measured
+    # livelock: commit frozen for thousands of ticks).
     # [leader, follower] is already the response orientation [receiver, responder].
     ar_out = is_ae
-    ar_success = sel & ae_ok[None, :]
-    ar_match = jnp.where(ar_success, last_new[None, :], 0)
+    if comp:
+        ar_success = sel & (ae_ok | snap)[None, :]
+        ok_match = jnp.where(
+            sel & snap[None, :],
+            L[None, :],
+            jnp.where(sel & ae_ok[None, :], last_new[None, :], 0),
+        )
+    else:
+        ar_success = sel & ae_ok[None, :]
+        ok_match = jnp.where(ar_success, last_new[None, :], 0)
+    ar_match = jnp.where(ar_out & ~ar_success, log_len[None, :], ok_match)
 
     # ---- phase 4: responses ------------------------------------------------------
     # Vote tally (vote-response-handler core.clj:125-139; dedup via bitmap mirrors the
@@ -247,9 +341,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     role = jnp.where(win, LEADER, role)
     leader_id = jnp.where(win, ids, leader_id)
     # Fresh leader bookkeeping (leader-state core.clj:40-42): nextIndex = last log
-    # index + 1, matchIndex = 0.
-    len16 = log_len.astype(jnp.int16)  # indices fit int16 (config caps log_capacity)
-    next_index = jnp.where(win[:, None], (len16 + 1)[:, None], s.next_index)
+    # index + 1, matchIndex = 0. Indices ride int16 when bounded by log_capacity,
+    # int32 under compaction (absolute indices; types.index_dtype).
+    len_i = log_len.astype(s.next_index.dtype)
+    next_index = jnp.where(win[:, None], (len_i + 1)[:, None], s.next_index)
     match_index = jnp.where(win[:, None], 0, s.match_index)
 
     # Append responses (append-response-handler core.clj:141-149), leaders only, same
@@ -267,7 +362,12 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     next_index = jnp.where(
         a_succ, jnp.maximum(next_index, r_match + 1), next_index
     )
-    next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
+    # Failure: back off to min(next-1, hint+1) -- the nack's match field carries
+    # the responder's log length (phase 3), so a far-behind or just-elected
+    # leader's probe converges in one round trip instead of one slot per nack.
+    next_index = jnp.where(
+        a_fail, jnp.maximum(jnp.minimum(next_index - 1, r_match + 1), 1), next_index
+    )
     # Responsiveness ages for the shared-window filter (phase 8): everyone ages one
     # tick (saturating); any AE response (success or failure) proves the peer is up
     # and zeroes its age, and a fresh win grace-zeroes every peer so the first
@@ -281,24 +381,89 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     sorted_desc = -jnp.sort(-match_with_self, axis=1)
     quorum_match = sorted_desc[:, cfg.quorum - 1]  # quorum-th largest match index
     # Spec 5.4.2: only commit entries from the current term by counting replicas.
-    quorum_term = log_ops.term_at(log_term_arr, quorum_match)
+    if comp:
+        quorum_term = log_ops.term_at_r(log_term_arr, base, bterm, quorum_match)
+    else:
+        quorum_term = log_ops.term_at(log_term_arr, quorum_match)
     commit = jnp.where(
         is_leader & inp.alive & (quorum_match > commit) & (quorum_term == term),
         quorum_match,
         commit,
     )
 
+    # ---- phase 5.5: log compaction -------------------------------------------------
+    # The reference's unbounded log vector (log.clj:33) needs none; the ring must
+    # free committed slots or a long-horizon client workload would exhaust it
+    # (commands rejected forever once log_len - log_base == CAP). Policy: whenever
+    # fewer than compact_margin free slots remain, advance base toward commit so up
+    # to CAP - compact_margin entries stay retained for laggard catch-up. base_chk
+    # is extended over the newly compacted span in the checksum pass below.
+    base_mid, bchk_mid = base, bchk  # post-install, pre-advance (checksum anchor)
+    if comp:
+        target = jnp.minimum(commit, log_len - (cap - cfg.compact_margin))
+        base2 = jnp.maximum(base, target)
+        bterm = log_ops.term_at_r(log_term_arr, base, bterm, base2)  # = bterm if unchanged
+        base = base2
+
+    # ---- committed-prefix checksum --------------------------------------------------
+    # One masked pass over the post-append arrays yields the old-prefix sum
+    # (invariant: equals the carried checksum), the compacted-prefix extension, and
+    # the new-prefix sum (log_ops module comment). All sums anchor at base_mid, the
+    # base BEFORE this tick's compaction advance. This pass MUST run before phase 6:
+    # an injection into a slot freed by this very tick's rebase would otherwise be
+    # read back under the just-compacted entry's weight (base_mid-anchored slot ->
+    # absolute-index map), silently corrupting base_chk. AE writes cannot alias
+    # (they only touch entries <= base + CAP, whose anchored indices are exact).
+    # The sums are part of load-bearing snapshot state (shipped as req_base_chk,
+    # persisted in checkpoints), so under compaction they are maintained even with
+    # invariant CHECKING off -- only the chk_ok comparison is gated.
+    if comp:
+        co = jnp.maximum(s.commit_index, base_mid)  # snap installs skip the check
+        s_co, s_bf, s_cn = log_ops.ring_chk(
+            log_term_arr, log_val_arr, base_mid, (co, base, commit)
+        )
+        if cfg.check_invariants:
+            chk_ok = (bchk_mid + s_co == s.commit_chk) | apply_snap
+        else:
+            chk_ok = jnp.ones((n,), bool)
+        bchk = bchk_mid + s_bf
+        chk_new = bchk_mid + s_cn
+    elif cfg.check_invariants:
+        chk_old, chk_new = log_ops.prefix_chk2(
+            log_term_arr, log_val_arr, s.commit_index, commit
+        )
+        chk_ok = chk_old == s.commit_chk
+    else:
+        chk_new = s.commit_chk
+        chk_ok = jnp.ones((n,), bool)
+
     # ---- phase 6: client command injection (client-set-handler core.clj:151-160) --
     # The simulator's "client" writes straight to the leader; the reference's
     # redirect-to-leader dance (core.clj:152-155) has no array equivalent because
-    # cluster membership is globally visible here.
-    do_inject = (inp.client_cmd != NIL) & is_leader & inp.alive & (log_len < cap)
-    inj_pos = jnp.where(do_inject, log_len, cap)  # cap = out of bounds -> dropped
+    # cluster membership is globally visible here. Under compaction, a fresh
+    # election win appends a leader NO-OP entry instead (spec 5.4.2 workaround:
+    # old-term entries only commit via a current-term entry at quorum, and a full
+    # ring of old-term entries would otherwise deadlock commit forever -- see
+    # docs/DESIGN.md); client injections keep `noop_reserve` slots free so a
+    # no-op slot survives commit-free election chains up to that depth.
+    client_ok = (inp.client_cmd != NIL) & is_leader & inp.alive
+    if comp:
+        reserve = max(1, cfg.compact_margin // 2)
+        noop = win & (log_len - base < cap)
+        client_ok = client_ok & ~noop & (log_len - base < cap - reserve)
+        do_write = noop | client_ok
+        wval = jnp.where(noop, NOOP, inp.client_cmd)
+    else:
+        client_ok = client_ok & (log_len - base < cap)
+        do_write = client_ok
+        wval = jnp.broadcast_to(inp.client_cmd, (n,))
+    do_inject = client_ok  # metrics count client accepts only, not leader no-ops
+    inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)
     log_term_arr = log_term_arr.at[ids, inj_pos].set(term, mode="drop")
     log_val_arr = log_val_arr.at[ids, inj_pos].set(
-        jnp.broadcast_to(inp.client_cmd, (n,)), mode="drop"
+        jnp.broadcast_to(wval, (n,)), mode="drop"
     )
-    log_len = log_len + do_inject
+    log_len = log_len + do_write
 
     # ---- phase 7: timers (generate-timeout core.clj:171-174; dispatch :193-195) ----
     clock = s.clock + inp.skew
@@ -326,7 +491,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
 
     # ---- phase 8: outbox ---------------------------------------------------------
     send_append = win | heartbeat  # fresh leaders heartbeat immediately (core.clj:137-138)
-    new_last_idx, new_last_term = log_ops.last_index_term(log_term_arr, log_len)
+    if comp:
+        new_last_idx = log_len
+        new_last_term = log_ops.term_at_r(log_term_arr, base, bterm, log_len)
+    else:
+        new_last_idx, new_last_term = log_ops.last_index_term(log_term_arr, log_len)
 
     # Request headers are PER SENDER -- both RPCs are broadcasts (request-vote-rpc
     # core.clj:48-54, append-entries-rpc core.clj:56-67); the only per-edge request
@@ -346,11 +515,20 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # (the consistency check at the too-high prev fails, it nacks, and that nack
     # both re-admits it to the responsive set and walks next_index back down).
     responsive = ack_age <= cfg.ack_timeout_ticks  # [src, dst]
-    big = cap + 1  # > any prev_out (prev_out <= log_len <= cap)
+    # big > any prev_out (prev_out <= log_len; absolute and unbounded under
+    # compaction, <= cap otherwise).
+    big = jnp.int32(2**31 - 1) if comp else (cap + 1)
     ws_resp = jnp.min(jnp.where(eye | ~responsive, big, prev_out), axis=1)  # [src]
     ws_all = jnp.min(jnp.where(eye, big, prev_out), axis=1)
-    ws = jnp.where(ws_resp > cap, ws_all, ws_resp)
+    none_resp = (ws_resp == big) if comp else (ws_resp > cap)
+    ws = jnp.where(none_resp, ws_all, ws_resp)
     ws = jnp.minimum(ws, log_len)
+    if comp:
+        # Entries below the compaction base are gone: the window cannot start
+        # before it, and peers whose prev falls below it get the InstallSnapshot
+        # sentinel (req_off = -1) instead of a window offset.
+        ws = jnp.maximum(ws, base)
+        snap_edge = ae_edge & (prev_out < base[:, None])
     # Clamp each peer's prev into [ws, ws+E]: spec-safe in both directions (a peer
     # ahead of the window gets a plain heartbeat over an older prefix it already
     # has, its redundant ack absorbed by the monotone max() updates of match/next
@@ -362,20 +540,29 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # Per-edge window offset j = prev - ws in 0..E; receivers reconstruct prev,
     # prev_term, and n_entries from (j, ent_start, ent_prev_term, ent_count).
     out_req_off = jnp.where(ae_edge, prev_out - ws[:, None], 0).astype(jnp.int8)
+    if comp:
+        out_req_off = jnp.where(snap_edge, jnp.int8(-1), out_req_off)
     # Zero unused window slots so the mailbox is canonical (receivers mask with
     # the derived n_ent anyway, but a canonical wire format keeps trajectories
     # bit-comparable).
     n_ship = jnp.clip(log_len - ws, 0, e)  # [src]
     ship_used = send_append[:, None] & (ks[None, :] < n_ship[:, None])  # [src, E]
-    out_ent_term = jnp.where(ship_used, log_ops.window(log_term_arr, ws, e), 0)
-    out_ent_val = jnp.where(ship_used, log_ops.window(log_val_arr, ws, e), 0)
+    wread = log_ops.window_r if comp else log_ops.window
+    out_ent_term = jnp.where(ship_used, wread(log_term_arr, ws, e), 0)
+    out_ent_val = jnp.where(ship_used, wread(log_val_arr, ws, e), 0)
 
     # Responses: vr_out/ar_out are [request-sender, request-receiver], which IS the
     # response orientation [response-receiver, responder] (the reference's resp-chan
     # round trip, server.clj:59-60 -> client.clj:34-40), packed into one word; the
     # responder's term rides per responder (same value toward every requester).
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match)
+    out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match, wide=comp)
+    z32 = jnp.zeros((n,), jnp.int32)
+    pterm = (
+        log_ops.term_at_r(log_term_arr, base, bterm, ws)
+        if comp
+        else log_ops.term_at(log_term_arr, ws)
+    )
 
     new_mb = Mailbox(
         req_type=out_req_type,
@@ -384,26 +571,21 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         req_last_index=jnp.where(start_election, new_last_idx, 0),
         req_last_term=jnp.where(start_election, new_last_term, 0),
         ent_start=jnp.where(send_append, ws, 0),
-        ent_prev_term=jnp.where(send_append, log_ops.term_at(log_term_arr, ws), 0),
+        ent_prev_term=jnp.where(send_append, pterm, 0),
         ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
         ent_val=out_ent_val,
+        req_base=jnp.where(send_append, base, 0) if comp else z32,
+        req_base_term=jnp.where(send_append, bterm, 0) if comp else z32,
+        req_base_chk=(
+            jnp.where(send_append, bchk, jnp.uint32(0))
+            if comp
+            else jnp.zeros((n,), jnp.uint32)
+        ),
         req_off=out_req_off,
         resp_word=out_resp_word,
         resp_term=term,
     )
-
-    # Committed-prefix checksum (log_ops module comment): one masked pass over the
-    # new arrays yields both the old-prefix sum (invariant: equals the carried
-    # checksum) and the new-prefix sum (the carried value for next tick).
-    if cfg.check_invariants:
-        chk_old, chk_new = log_ops.prefix_chk2(
-            log_term_arr, log_val_arr, s.commit_index, commit
-        )
-        chk_ok = chk_old == s.commit_chk
-    else:
-        chk_new = s.commit_chk
-        chk_ok = jnp.ones((n,), bool)
 
     new_state = ClusterState(
         role=role,
@@ -416,6 +598,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         ack_age=ack_age,
         commit_index=commit,
         commit_chk=chk_new,
+        log_base=base,
+        base_term=bterm,
+        base_chk=bchk,
         log_term=log_term_arr,
         log_val=log_val_arr,
         log_len=log_len,
@@ -460,7 +645,8 @@ def _step_info(
             & ~eye
         )
         viol_election = jnp.any(pair_bad)
-        # Commit sanity: monotonic, within the log, and the committed prefix is
+        # Commit sanity: monotonic, within the log, above the compaction base (with
+        # the retained window inside the ring), and the committed prefix is
         # immutable -- entries below the old commit index never change term OR value
         # (state-machine-safety analogue of the reference's apply-entries! writing
         # committed values to an append-only file, log.clj:69-76). Immutability is
@@ -468,6 +654,8 @@ def _step_info(
         viol_commit = jnp.any(
             (new.commit_index < old.commit_index)
             | (new.commit_index > new.log_len)
+            | (new.commit_index < new.log_base)
+            | (new.log_len - new.log_base > cfg.log_capacity)
             | ~chk_ok
         )
     else:
@@ -476,14 +664,48 @@ def _step_info(
 
     if cfg.check_log_matching:
         # Log matching on committed prefixes: any two nodes agree on every entry
-        # (term AND value) up to min(commit_i, commit_j). O(N^2 * CAP) -- gated.
+        # (term AND value) up to m = min(commit_i, commit_j). O(N^2 * CAP) -- gated.
         minc = jnp.minimum(new.commit_index[:, None], new.commit_index[None, :])
-        ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
-        both = ks[None, None, :] < minc[:, :, None]
         differ = (new.log_term[:, None, :] != new.log_term[None, :, :]) | (
             new.log_val[:, None, :] != new.log_val[None, :, :]
         )
-        viol_match = jnp.any(both & differ)
+        if not cfg.compaction:
+            ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
+            both = ks[None, None, :] < minc[:, :, None]
+            viol_match = jnp.any(both & differ)
+        else:
+            # Ring form, in two parts per pair (i, j) with mb = max(base_i, base_j):
+            # entries in (mb, m] are live in BOTH rings at the same slot (same
+            # absolute index, same CAP) -> compare slots; the prefix up to mb is
+            # compared via checksums-at-mb (chk_at(i, p) = base_chk_i + live sum
+            # (base_i, p]), which is computable because mb >= base_i. Pairs where
+            # one node compacted past the other's commit (m < mb) are skipped --
+            # their agreement is pinned transitively through common peers.
+            cap_ = cfg.log_capacity
+            sl = jnp.arange(cap_, dtype=jnp.int32)[None, :]
+            b = new.log_base
+            abs0 = b[:, None] + (sl - b[:, None]) % cap_  # [N, CAP] entry idx - 1
+            mb_ = jnp.maximum(b[:, None], b[None, :])  # [N, N]
+            comparable = minc >= mb_
+            in_i = (abs0[:, None, :] >= mb_[:, :, None]) & (
+                abs0[:, None, :] < minc[:, :, None]
+            )
+            in_j = (abs0[None, :, :] >= mb_[:, :, None]) & (
+                abs0[None, :, :] < minc[:, :, None]
+            )
+            viol_suffix = jnp.any(comparable[:, :, None] & in_i & in_j & differ)
+            w_t, w_v = log_ops.chk_weights_at(abs0)
+            contrib = (
+                new.log_term.astype(jnp.uint32) * w_t
+                + new.log_val.astype(jnp.uint32) * w_v
+            )  # [N, CAP]
+            chk_at_mb = new.base_chk[:, None] + jnp.sum(
+                jnp.where(abs0[:, None, :] < mb_[:, :, None], contrib[:, None, :], jnp.uint32(0)),
+                axis=2,
+                dtype=jnp.uint32,
+            )  # [N(i), N(j)] = chk of node i's prefix at mb(i, j)
+            viol_prefix = jnp.any(comparable & (chk_at_mb != chk_at_mb.T))
+            viol_match = viol_suffix | viol_prefix
     else:
         viol_match = f
 

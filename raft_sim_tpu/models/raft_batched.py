@@ -26,6 +26,7 @@ from raft_sim_tpu.types import (
     FOLLOWER,
     LEADER,
     NIL,
+    NOOP,
     REQ_APPEND,
     REQ_VOTE,
     RESP_APPEND,
@@ -56,6 +57,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     Mirrors raft.step phase by phase; see that function for the reference citations.
     """
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
+    comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
     b = s.role.shape[-1]
     # All iota-style constants are built at their final rank (log_ops.iota): Mosaic
     # cannot lower unit-dim-appending reshapes, and this module doubles as the
@@ -66,6 +68,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     snd_ids = iota((n, n, 1), 0)  # [sender, receiver, 1] -> sender id
 
     # ---- phase -1: restart (crash fault) -----------------------------------------
+    # The snapshot triple is persistent: commit resumes at log_base (raft.py).
     rs = inp.restarted  # [N, B]
     rs2 = rs[:, None, :]
     s = s._replace(
@@ -75,11 +78,12 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         next_index=jnp.where(rs2, 1, s.next_index),
         match_index=jnp.where(rs2, 0, s.match_index),
         ack_age=jnp.where(rs2, ACK_AGE_SAT, s.ack_age),
-        commit_index=jnp.where(rs, 0, s.commit_index),
-        commit_chk=jnp.where(rs, jnp.uint32(0), s.commit_chk),
+        commit_index=jnp.where(rs, s.log_base, s.commit_index),
+        commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
     mb = s.mailbox
+    base, bterm, bchk = s.log_base, s.base_term, s.base_chk  # [N, B]
 
     # ---- phase 0: delivery -------------------------------------------------------
     # Input mask is per physical edge [to, from]; requests ([sender, receiver]) read
@@ -108,7 +112,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     leader_id = jnp.where(saw_higher, NIL, s.leader_id)
     votes = s.votes & ~saw_higher[:, None, :]
 
-    my_last_idx, my_last_term = log_ops.last_index_term_b(s.log_term, s.log_len)
+    if comp:
+        my_last_idx = s.log_len
+        my_last_term = log_ops.term_at_rb(s.log_term, base, bterm, s.log_len)
+    else:
+        my_last_idx, my_last_term = log_ops.last_index_term_b(s.log_term, s.log_len)
 
     # ---- phase 2: RequestVote requests -------------------------------------------
     is_rv = req_in & (mb.req_type == REQ_VOTE)[:, None, :]  # [candidate, voter, B]
@@ -143,10 +151,19 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     # is zeros and gated by has_ae/ae_ok downstream.
     pick_h = lambda h: jnp.sum(jnp.where(sel, h[:, None, :], 0), axis=0)  # [N, B]
     j_in = jnp.sum(jnp.where(sel, mb.req_off, 0), axis=0).astype(jnp.int32)  # [N, B] in 0..E
+    # InstallSnapshot analogue: offset sentinel -1 (raft.py phase 3).
+    if comp:
+        snap = has_ae & (j_in < 0)
+        ae_norm = has_ae & ~snap
+        j_nn = jnp.clip(j_in, 0, e)
+    else:
+        snap = jnp.zeros_like(has_ae)
+        ae_norm = has_ae
+        j_nn = j_in
     ws_in = pick_h(mb.ent_start)
     lcommit = pick_h(mb.req_commit)
-    prev_i = jnp.where(has_ae, ws_in + j_in, 0)
-    n_ent = jnp.where(has_ae, jnp.clip(pick_h(mb.ent_count) - j_in, 0, e), 0)
+    prev_i = jnp.where(ae_norm, ws_in + j_nn, 0)
+    n_ent = jnp.where(ae_norm, jnp.clip(pick_h(mb.ent_count) - j_nn, 0, e), 0)
     # One masked reduction selects BOTH window planes (same one-hot mask): terms
     # and values ride a single [N, N, 2E, B] pass, split after.
     ent_tv = jnp.concatenate([mb.ent_term, mb.ent_val], axis=1)  # [N, 2E, B]
@@ -158,44 +175,107 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     ext = jnp.concatenate(
         [pick_h(mb.ent_prev_term)[:, None, :], w_term_in], axis=1
     )  # [N, E+1, B]
-    oh_j = iota((1, e + 1, 1), 1) == j_in[:, None, :]
+    oh_j = iota((1, e + 1, 1), 1) == j_nn[:, None, :]
     prev_t = jnp.sum(jnp.where(oh_j, ext, 0), axis=1)  # [N, B]
     # This receiver's entries start at window slot j (slot k holds entry ws+k+1).
-    off = jnp.clip(j_in, 0, e - 1)  # j = E only when n_ent = 0 (fully masked)
+    off = jnp.clip(j_nn, 0, e - 1)  # j = E only when n_ent = 0 (fully masked)
     ent_term_in = log_ops.window_b(w_term_in, off, e)  # [N, E, B]
     ent_val_in = log_ops.window_b(w_val_in, off, e)
 
     role = jnp.where(has_ae & (role == CANDIDATE), FOLLOWER, role)
     leader_id = jnp.where(has_ae, ae_src, leader_id)
 
-    prev_stored_term = log_ops.term_at_b(s.log_term, prev_i)
-    consistent = (prev_i == 0) | ((prev_i <= s.log_len) & (prev_stored_term == prev_t))
-    ae_ok = has_ae & consistent
+    if comp:
+        prev_stored_term = log_ops.term_at_rb(s.log_term, base, bterm, prev_i)
+        # prev below the local base is committed-and-compacted: consistent by
+        # leader completeness; at prev == base the check is against base_term.
+        consistent = (
+            (prev_i == 0)
+            | (prev_i < base)
+            | ((prev_i <= s.log_len) & (prev_stored_term == prev_t))
+        )
+    else:
+        prev_stored_term = log_ops.term_at_b(s.log_term, prev_i)
+        consistent = (prev_i == 0) | (
+            (prev_i <= s.log_len) & (prev_stored_term == prev_t)
+        )
+    ae_ok = ae_norm & consistent
 
     ks_e = iota((1, e, 1), 1)  # [1, E, 1]
-    gidx0 = prev_i[:, None, :] + ks_e  # [N, E, B] 0-based slots
-    in_ent = ks_e < n_ent[:, None, :]
+    gidx0 = prev_i[:, None, :] + ks_e  # [N, E, B] 0-based entry indices
+    if comp:
+        # Skip already-compacted entries, accept only what the ring can hold
+        # (raft.py phase 3).
+        lo = jnp.clip(base - prev_i, 0, e)  # [N, B]
+        n_acc = jnp.minimum(n_ent, jnp.maximum(base + cap - prev_i, 0))
+        in_ent = (ks_e >= lo[:, None, :]) & (ks_e < n_acc[:, None, :])
+        stored = log_ops.window_rb(s.log_term, prev_i, e)  # [N, E, B]
+        appended_len = prev_i + n_acc
+    else:
+        n_acc = n_ent
+        in_ent = ks_e < n_ent[:, None, :]
+        stored = log_ops.window_b(s.log_term, prev_i, e)  # [N, E, B]
+        appended_len = jnp.minimum(prev_i + n_ent, cap)
     exists = gidx0 < s.log_len[:, None, :]
-    stored = log_ops.window_b(s.log_term, prev_i, e)  # [N, E, B]
     mismatch = in_ent & exists & (stored != ent_term_in)
     any_mismatch = jnp.any(mismatch, axis=1)  # [N, B]
-    appended_len = jnp.minimum(prev_i + n_ent, cap)
     new_len = jnp.where(any_mismatch, appended_len, jnp.maximum(s.log_len, appended_len))
     log_len = jnp.where(ae_ok, new_len, s.log_len)
-    log_term_arr = log_ops.write_window_b(s.log_term, prev_i, ent_term_in, ae_ok, n_ent)
-    log_val_arr = log_ops.write_window_b(s.log_val, prev_i, ent_val_in, ae_ok, n_ent)
+    if comp:
+        log_term_arr = log_ops.write_window_rb(
+            s.log_term, prev_i, ent_term_in, ae_ok, lo, n_acc
+        )
+        log_val_arr = log_ops.write_window_rb(
+            s.log_val, prev_i, ent_val_in, ae_ok, lo, n_acc
+        )
+    else:
+        log_term_arr = log_ops.write_window_b(s.log_term, prev_i, ent_term_in, ae_ok, n_ent)
+        log_val_arr = log_ops.write_window_b(s.log_val, prev_i, ent_val_in, ae_ok, n_ent)
 
-    last_new = jnp.minimum(prev_i + n_ent, log_len)
+    last_new = jnp.minimum(prev_i + n_acc, log_len)
     commit = jnp.where(
         ae_ok,
         jnp.maximum(s.commit_index, jnp.minimum(lcommit, last_new)),
         s.commit_index,
     )
 
-    # [leader, follower] is already the response orientation [receiver, responder].
+    # Snapshot install (raft.py phase 3): adopt the sender's compaction state,
+    # retaining our suffix when it extends through L with the snapshot's term.
+    if comp:
+        L = jnp.where(snap, pick_h(mb.req_base), 0)
+        Lt = pick_h(mb.req_base_term)
+        Lchk = jnp.sum(jnp.where(sel, mb.req_base_chk[:, None, :], jnp.uint32(0)), axis=0)
+        apply_snap = snap & (L > base)
+        keep = (
+            apply_snap
+            & (L <= s.log_len)
+            & (log_ops.term_at_rb(s.log_term, base, bterm, L) == Lt)
+        )
+        wipe = apply_snap & ~keep
+        bterm = jnp.where(apply_snap, Lt, bterm)
+        bchk = jnp.where(apply_snap, Lchk, bchk)
+        base = jnp.where(apply_snap, L, base)
+        log_len = jnp.where(wipe, L, log_len)
+        commit = jnp.where(apply_snap, jnp.maximum(commit, L), commit)
+    else:
+        apply_snap = snap
+
+    # [leader, follower] is already the response orientation [receiver, responder]
+    # (snapshot installs always ack, with match = the snapshot index). A NACK's
+    # match field carries the responder's log length as the conflict-index
+    # catch-up hint (raft.py phase 3).
     ar_out = is_ae
-    ar_success = sel & ae_ok[None, :, :]
-    ar_match = jnp.where(ar_success, last_new[None, :, :], 0)
+    if comp:
+        ar_success = sel & (ae_ok | snap)[None, :, :]
+        ok_match = jnp.where(
+            sel & snap[None, :, :],
+            L[None, :, :],
+            jnp.where(sel & ae_ok[None, :, :], last_new[None, :, :], 0),
+        )
+    else:
+        ar_success = sel & ae_ok[None, :, :]
+        ok_match = jnp.where(ar_success, last_new[None, :, :], 0)
+    ar_match = jnp.where(ar_out & ~ar_success, log_len[None, :, :], ok_match)
 
     # ---- phase 4: responses ------------------------------------------------------
     vresp = resp_in & (r_type == RESP_VOTE)
@@ -212,8 +292,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     leader_id = jnp.where(win, ids2, leader_id)
     # Log indices fit int16 (config caps log_capacity); keeping the [N, N, B]
     # bookkeeping planes and their intermediates at 2 bytes halves their HBM cost.
-    len16 = log_len.astype(jnp.int16)
-    next_index = jnp.where(win[:, None, :], (len16 + 1)[:, None, :], s.next_index)
+    # Compaction carries absolute indices: int32 (types.index_dtype).
+    len_i = log_len.astype(s.next_index.dtype)
+    next_index = jnp.where(win[:, None, :], (len_i + 1)[:, None, :], s.next_index)
     match_index = jnp.where(win[:, None, :], 0, s.match_index)
 
     aresp = (
@@ -226,14 +307,17 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     a_fail = aresp & (r_ok == 0)
     match_index = jnp.where(a_succ, jnp.maximum(match_index, r_match), match_index)
     next_index = jnp.where(a_succ, jnp.maximum(next_index, r_match + 1), next_index)
-    next_index = jnp.where(a_fail, jnp.maximum(next_index - 1, 1), next_index)
+    # Failure: back off to min(next-1, hint+1) (conflict-index hint; raft.py).
+    next_index = jnp.where(
+        a_fail, jnp.maximum(jnp.minimum(next_index - 1, r_match + 1), 1), next_index
+    )
     # Responsiveness ages for the shared-window filter (phase 8; see raft.py).
     ack_age = jnp.minimum(s.ack_age + 1, ACK_AGE_SAT)
     ack_age = jnp.where(win[:, None, :] | aresp, 0, ack_age)
 
     # ---- phase 5: leader commit advancement --------------------------------------
     is_leader = role == LEADER
-    match_with_self = jnp.where(eye3, len16[:, None, :], match_index)  # [N, N, B] i16
+    match_with_self = jnp.where(eye3, len_i[:, None, :], match_index)  # [N, N, B]
     # quorum-th largest match without a sort (TPU sorts along a non-minor axis are
     # slow). Two equivalent counting forms; pick per static shapes:
     #   cap < n  (config5: N=51, CAP=16): match values are bounded by CAP, so count
@@ -245,7 +329,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     #     count(match >= v) >= quorum. O(N^2) compares per leader, independent of CAP
     #     (the CAP-threshold form would do ~6x the work at N=5, CAP=32 and ~400x at
     #     config1's CAP=2048).
-    if cap < n:
+    if cap < n and not comp:
+        # Thresholds 1..CAP only bound match values when indices are capacity-
+        # bounded; compaction's absolute indices use the value-threshold form.
         vth = (iota((1, 1, cap, 1), 2) + 1).astype(jnp.int16)  # thresholds 1..CAP
         cnt_ge = jnp.sum(match_with_self[:, :, None, :] >= vth, axis=1)  # [N, CAP, B]
         quorum_match = jnp.sum(cnt_ge >= cfg.quorum, axis=1).astype(jnp.int32)  # [N, B]
@@ -255,20 +341,68 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         )  # [N, j(candidate), k(counted), B]
         ok = jnp.sum(ge, axis=2) >= cfg.quorum  # [N, N, B]
         quorum_match = jnp.max(jnp.where(ok, match_with_self, 0), axis=1)  # [N, B]
-    quorum_term = log_ops.term_at_b(log_term_arr, quorum_match)
+    if comp:
+        quorum_term = log_ops.term_at_rb(log_term_arr, base, bterm, quorum_match)
+    else:
+        quorum_term = log_ops.term_at_b(log_term_arr, quorum_match)
     commit = jnp.where(
         is_leader & inp.alive & (quorum_match > commit) & (quorum_term == term),
         quorum_match,
         commit,
     )
 
-    # ---- phase 6: client command injection ----------------------------------------
-    do_inject = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive & (log_len < cap)
-    inj_pos = jnp.where(do_inject, log_len, cap)  # [N, B]; cap matches no slot
+    # ---- phase 5.5: log compaction (raft.py) -------------------------------------
+    base_mid, bchk_mid = base, bchk  # post-install, pre-advance (checksum anchor)
+    if comp:
+        target = jnp.minimum(commit, log_len - (cap - cfg.compact_margin))
+        base2 = jnp.maximum(base, target)
+        bterm = log_ops.term_at_rb(log_term_arr, base, bterm, base2)  # = bterm if unchanged
+        base = base2
+
+    # ---- committed-prefix checksum (raft.py: anchored at base_mid, MUST run
+    # before phase 6 -- an injection into a slot freed by this tick's rebase would
+    # alias under the anchored slot->index map; maintained even with invariant
+    # checking off under compaction, since base_chk is load-bearing wire state) ----
+    if comp:
+        co = jnp.maximum(s.commit_index, base_mid)  # snap installs skip the check
+        s_co, s_bf, s_cn = log_ops.ring_chk_b(
+            log_term_arr, log_val_arr, base_mid, (co, base, commit)
+        )
+        if cfg.check_invariants:
+            chk_ok = (bchk_mid + s_co == s.commit_chk) | apply_snap
+        else:
+            chk_ok = jnp.ones_like(s.commit_index, dtype=bool)
+        bchk = bchk_mid + s_bf
+        chk_new = bchk_mid + s_cn
+    elif cfg.check_invariants:
+        chk_old, chk_new = log_ops.prefix_chk2_b(
+            log_term_arr, log_val_arr, s.commit_index, commit
+        )
+        chk_ok = chk_old == s.commit_chk
+    else:
+        chk_new = s.commit_chk
+        chk_ok = jnp.ones_like(s.commit_index, dtype=bool)
+
+    # ---- phase 6: client command injection (+ election-win no-op under
+    # compaction; raft.py phase 6) --------------------------------------------------
+    client_ok = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive
+    if comp:
+        reserve = max(1, cfg.compact_margin // 2)
+        noop = win & (log_len - base < cap)
+        client_ok = client_ok & ~noop & (log_len - base < cap - reserve)
+        do_write = noop | client_ok
+        wval = jnp.where(noop, NOOP, inp.client_cmd[None, :])
+    else:
+        client_ok = client_ok & (log_len - base < cap)
+        do_write = client_ok
+        wval = jnp.broadcast_to(inp.client_cmd[None, :], log_len.shape)
+    do_inject = client_ok  # metrics count client accepts only, not leader no-ops
+    # cap matches no slot -> masked-off writes dropped.
+    inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)  # [N, B]
     inj_oh = iota((1, cap, 1), 1) == inj_pos[:, None, :]  # [N, CAP, B]
     log_term_arr = jnp.where(inj_oh, term[:, None, :], log_term_arr)
-    log_val_arr = jnp.where(inj_oh, inp.client_cmd[None, None, :], log_val_arr)
-    log_len = log_len + do_inject
+    log_val_arr = jnp.where(inj_oh, wval[:, None, :], log_val_arr)
+    log_len = log_len + do_write
 
     # ---- phase 7: timers ---------------------------------------------------------
     clock = s.clock + inp.skew
@@ -291,7 +425,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
 
     # ---- phase 8: outbox ---------------------------------------------------------
     send_append = win | heartbeat
-    new_last_idx, new_last_term = log_ops.last_index_term_b(log_term_arr, log_len)
+    if comp:
+        new_last_idx = log_len
+        new_last_term = log_ops.term_at_rb(log_term_arr, base, bterm, log_len)
+    else:
+        new_last_idx, new_last_term = log_ops.last_index_term_b(log_term_arr, log_len)
 
     # Request headers are per sender (both RPCs are broadcasts); only the AE window
     # offset is per edge (Mailbox docstring; raft.py phase 8).
@@ -299,22 +437,33 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_req_type = jnp.where(
         start_election, REQ_VOTE, jnp.where(send_append, REQ_APPEND, 0)
     )  # [N, B]
-    prev_out = jnp.clip(next_index - 1, 0, len16[:, None, :])  # [src, dst, B] i16
+    prev_out = jnp.clip(next_index - 1, 0, len_i[:, None, :])  # [src, dst, B]
     # Shared window start: minimum prev over RESPONSIVE peers, falling back to all
     # peers when none are (see raft.py phase 8 for the liveness argument).
     responsive = ack_age <= cfg.ack_timeout_ticks
-    big = cap + 1
+    big = jnp.int32(2**31 - 1) if comp else (cap + 1)
     ws_resp = jnp.min(jnp.where(eye3 | ~responsive, big, prev_out), axis=1)  # [N, B]
     ws_all = jnp.min(jnp.where(eye3, big, prev_out), axis=1)
-    ws = jnp.where(ws_resp > cap, ws_all, ws_resp)
-    ws = jnp.minimum(ws, len16)  # i16 throughout; widened only at the header writes
+    none_resp = (ws_resp == big) if comp else (ws_resp > cap)
+    ws = jnp.where(none_resp, ws_all, ws_resp)
+    ws = jnp.minimum(ws, len_i)  # narrow dtype throughout; widened at header writes
+    if comp:
+        # The window cannot start below the compaction base; peers whose prev fell
+        # below it get the InstallSnapshot sentinel (raft.py phase 8).
+        ws = jnp.maximum(ws, base)
+        snap_edge = ae_edge & (prev_out < base[:, None, :])
     # Clamp prev into [ws, ws+E] (see raft.py): the per-edge request payload then
     # reduces to the offset j = prev - ws in 0..E; receivers reconstruct prev,
     # prev_term, and n_entries from it and the per-sender header.
     prev_out = jnp.clip(prev_out, ws[:, None, :], (ws + e)[:, None, :])
     out_req_off = jnp.where(ae_edge, prev_out - ws[:, None, :], 0).astype(jnp.int8)
-    wt = log_ops.window_b(log_term_arr, ws, e)  # [N, E, B] shared window terms
-    wv = log_ops.window_b(log_val_arr, ws, e)
+    if comp:
+        out_req_off = jnp.where(snap_edge, jnp.int8(-1), out_req_off)
+        wt = log_ops.window_rb(log_term_arr, ws, e)  # [N, E, B] shared window terms
+        wv = log_ops.window_rb(log_val_arr, ws, e)
+    else:
+        wt = log_ops.window_b(log_term_arr, ws, e)
+        wv = log_ops.window_b(log_val_arr, ws, e)
     n_ship = jnp.clip(log_len - ws, 0, e)  # [N, B]
     ship_used = send_append[:, None, :] & (iota((1, e, 1), 1) < n_ship[:, None, :])
     out_ent_term = jnp.where(ship_used, wt, 0)
@@ -325,7 +474,12 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     # transpose-free and now also broadcast-free: nothing [N, N]-shaped is written
     # beyond the offset and response planes.
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match)
+    out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match, wide=comp)
+    if comp:
+        pterm = log_ops.term_at_rb(log_term_arr, base, bterm, ws)
+    else:
+        pterm = log_ops.term_at_b(log_term_arr, ws)
+    zb = jnp.zeros_like(s.commit_index)
 
     new_mb = Mailbox(
         req_type=out_req_type,
@@ -334,24 +488,21 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         req_last_index=jnp.where(start_election, new_last_idx, 0),
         req_last_term=jnp.where(start_election, new_last_term, 0),
         ent_start=jnp.where(send_append, ws.astype(jnp.int32), 0),
-        ent_prev_term=jnp.where(send_append, log_ops.term_at_b(log_term_arr, ws), 0),
+        ent_prev_term=jnp.where(send_append, pterm, 0),
         ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
         ent_val=out_ent_val,
+        req_base=jnp.where(send_append, base, 0) if comp else zb,
+        req_base_term=jnp.where(send_append, bterm, 0) if comp else zb,
+        req_base_chk=(
+            jnp.where(send_append, bchk, jnp.uint32(0))
+            if comp
+            else jnp.zeros_like(s.base_chk)
+        ),
         req_off=out_req_off,
         resp_word=out_resp_word,
         resp_term=term,
     )
-
-    # Committed-prefix checksum (log_ops module comment; raft.py).
-    if cfg.check_invariants:
-        chk_old, chk_new = log_ops.prefix_chk2_b(
-            log_term_arr, log_val_arr, s.commit_index, commit
-        )
-        chk_ok = chk_old == s.commit_chk
-    else:
-        chk_new = s.commit_chk
-        chk_ok = jnp.ones_like(s.commit_index, dtype=bool)
 
     new_state = ClusterState(
         role=role,
@@ -364,6 +515,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         ack_age=ack_age,
         commit_index=commit,
         commit_chk=chk_new,
+        log_base=base,
+        base_term=bterm,
+        base_chk=bchk,
         log_term=log_term_arr,
         log_val=log_val_arr,
         log_len=log_len,
@@ -404,10 +558,13 @@ def _step_info_b(
             & ~eye3
         )
         viol_election = jnp.any(pair_bad, axis=(0, 1))
-        # Committed-prefix immutability via the carried checksum (raft._step_info).
+        # Committed-prefix immutability via the carried checksum (raft._step_info),
+        # plus the compaction bounds (base <= commit, retained window <= CAP).
         viol_commit = jnp.any(
             (new.commit_index < old.commit_index)
             | (new.commit_index > new.log_len)
+            | (new.commit_index < new.log_base)
+            | (new.log_len - new.log_base > cfg.log_capacity)
             | ~chk_ok,
             axis=0,
         )
@@ -417,11 +574,49 @@ def _step_info_b(
 
     if cfg.check_log_matching:
         minc = jnp.minimum(new.commit_index[:, None, :], new.commit_index[None, :, :])
-        both = iota((1, 1, cfg.log_capacity, 1), 2) < minc[:, :, None, :]
         differ = (new.log_term[:, None] != new.log_term[None, :]) | (
             new.log_val[:, None] != new.log_val[None, :]
-        )
-        viol_match = jnp.any(both & differ, axis=(0, 1, 2))
+        )  # [N, N, CAP, B]
+        if not cfg.compaction:
+            both = iota((1, 1, cfg.log_capacity, 1), 2) < minc[:, :, None, :]
+            viol_match = jnp.any(both & differ, axis=(0, 1, 2))
+        else:
+            # Ring form (see raft._step_info): slots live in BOTH rings over
+            # (max base, min commit] compare directly; the shared prefix below
+            # max(base_i, base_j) compares via checksums-at-mb.
+            cap = cfg.log_capacity
+            bb = new.log_base  # [N, B]
+            sl = iota((1, cap, 1), 1)
+            abs0 = bb[:, None, :] + (sl - bb[:, None, :]) % cap  # [N, CAP, B]
+            mb_ = jnp.maximum(bb[:, None, :], bb[None, :, :])  # [N, N, B]
+            comparable = minc >= mb_
+            in_i = (abs0[:, None, :, :] >= mb_[:, :, None, :]) & (
+                abs0[:, None, :, :] < minc[:, :, None, :]
+            )
+            in_j = (abs0[None, :, :, :] >= mb_[:, :, None, :]) & (
+                abs0[None, :, :, :] < minc[:, :, None, :]
+            )
+            viol_suffix = jnp.any(
+                comparable[:, :, None, :] & in_i & in_j & differ, axis=(0, 1, 2)
+            )
+            w_t, w_v = log_ops.chk_weights_at(abs0)
+            contrib = (
+                new.log_term.astype(jnp.uint32) * w_t
+                + new.log_val.astype(jnp.uint32) * w_v
+            )  # [N, CAP, B]
+            chk_at_mb = new.base_chk[:, None, :] + jnp.sum(
+                jnp.where(
+                    abs0[:, None, :, :] < mb_[:, :, None, :],
+                    contrib[:, None, :, :],
+                    jnp.uint32(0),
+                ),
+                axis=2,
+                dtype=jnp.uint32,
+            )  # [N(i), N(j), B]
+            viol_prefix = jnp.any(
+                comparable & (chk_at_mb != jnp.swapaxes(chk_at_mb, 0, 1)), axis=(0, 1)
+            )
+            viol_match = viol_suffix | viol_prefix
     else:
         viol_match = f
 

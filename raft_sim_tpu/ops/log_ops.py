@@ -98,12 +98,20 @@ def write_window(
 # --------------------------------------------------------------------------------------
 
 
-def chk_weights(cap: int):
-    """Per-slot odd uint32 mixing weights (terms, values) for the prefix checksum."""
-    k = jnp.arange(cap, dtype=jnp.uint32)
-    w_term = (k * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
-    w_val = (k * jnp.uint32(0x85EBCA77) + jnp.uint32(0xC2B2AE3D)) | jnp.uint32(1)
+def chk_weights_at(abs0):
+    """Odd uint32 mixing weights (terms, values) for an array of ABSOLUTE 0-based
+    entry indices -- the general form of chk_weights, needed once compaction makes
+    a slot's entry index exceed its slot number (ring layout)."""
+    a = abs0.astype(jnp.uint32)
+    w_term = (a * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
+    w_val = (a * jnp.uint32(0x85EBCA77) + jnp.uint32(0xC2B2AE3D)) | jnp.uint32(1)
     return w_term, w_val
+
+
+def chk_weights(cap: int):
+    """Per-slot weights for the prefix (non-ring) layout, where slot k holds the
+    0-based entry k."""
+    return chk_weights_at(jnp.arange(cap, dtype=jnp.uint32))
 
 
 def prefix_chk2(log_term, log_val, upto_a, upto_b):
@@ -137,6 +145,79 @@ def prefix_chk2_b(log_term, log_val, upto_a, upto_b):
     return (
         jnp.sum(jnp.where(in_a, contrib, z), axis=1, dtype=jnp.uint32),
         jnp.sum(jnp.where(in_b, contrib, z), axis=1, dtype=jnp.uint32),
+    )
+
+
+# --------------------------------------------------------------------------------------
+# Ring variants (compaction, cfg.compact_margin > 0): 1-based entry i lives at slot
+# (i - 1) mod CAP; live slots hold entries (log_base, log_len] with
+# log_len - log_base <= CAP (types.ClusterState). Entries at or below log_base exist
+# only as (log_base, base_term, base_chk). With log_base == 0 every ring form
+# degenerates to its prefix counterpart bit-for-bit; the kernels still call the
+# prefix forms for non-compaction configs so those stay mod-free.
+# --------------------------------------------------------------------------------------
+
+
+def term_at_r(log_term: jax.Array, base: jax.Array, base_term: jax.Array, index1):
+    """Ring-aware term_at: the ring slot's term for base < index1 <= base + CAP;
+    base_term for 0 < index1 <= base (the compacted prefix -- callers gate on what
+    the protocol may actually compare there); 0 for index1 == 0.
+
+    log_term: [N, CAP]; base/base_term: [N]; index1: [N] or [N, K].
+    """
+    cap = log_term.shape[-1]
+    idx = (index1 - 1) % cap
+    if index1.ndim == 1:
+        got = jnp.take_along_axis(log_term, idx[:, None], axis=1)[:, 0]
+    else:
+        got = jnp.take_along_axis(log_term, idx, axis=1)
+        base = base[:, None]
+        base_term = base_term[:, None]
+    return jnp.where(index1 == 0, 0, jnp.where(index1 <= base, base_term, got))
+
+
+def window_r(arr: jax.Array, start0: jax.Array, e: int) -> jax.Array:
+    """Ring window: out[..., k] = arr[row, (start0 + k) mod CAP]. Callers mask with
+    an explicit count (slots past the live range hold unrelated ring content)."""
+    cap = arr.shape[-1]
+    ks = jnp.arange(e, dtype=jnp.int32)
+    pos = (start0[..., None] + ks) % cap
+    n = arr.shape[0]
+    rows = jnp.arange(n)[:, None] if start0.ndim == 1 else jnp.arange(n)[:, None, None]
+    return arr[rows, pos]
+
+
+def write_window_r(
+    arr: jax.Array, start0: jax.Array, vals: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Ring write_window: vals[n, k] -> arr[n, (start0[n] + k) mod CAP] where
+    mask[n, k]. Masked-on positions are distinct mod CAP because the caller keeps
+    the retained window within CAP (log_len - log_base <= CAP)."""
+    n, cap = arr.shape
+    e = vals.shape[-1]
+    ks = jnp.arange(e, dtype=jnp.int32)
+    pos = jnp.where(mask, (start0[:, None] + ks) % cap, cap)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, e))
+    return arr.at[rows, pos].set(vals, mode="drop")
+
+
+def ring_chk(log_term, log_val, base, uptos: tuple):
+    """Checksum sums over live ring entries (base, upto] for each upto in `uptos`,
+    weighted by ABSOLUTE entry index -- the ring generalization of prefix_chk2
+    (bit-identical for base == 0). A node's checksum-at-prefix-p is then
+    base_chk + ring_chk(..., (p,))[0] for any p in [base, log_len].
+
+    log_term/log_val: [N, CAP]; base: [N]; returns a tuple of uint32 [N].
+    """
+    cap = log_term.shape[-1]
+    s = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    abs0 = base[:, None] + (s - base[:, None]) % cap  # 0-based entry index of slot s
+    w_t, w_v = chk_weights_at(abs0)
+    contrib = log_term.astype(jnp.uint32) * w_t + log_val.astype(jnp.uint32) * w_v
+    z = jnp.uint32(0)
+    return tuple(
+        jnp.sum(jnp.where(abs0 < u[:, None], contrib, z), axis=1, dtype=jnp.uint32)
+        for u in uptos
     )
 
 
@@ -233,3 +314,57 @@ def write_window_b(
     hit = (cs >= start0[:, None, :]) & (cs < (start0 + count)[:, None, :])
     val = jnp.sum(jnp.where(oh, vals[:, :, None, :], 0), axis=1)
     return jnp.where(hit, val, arr)
+
+
+# ---- batch-minor ring forms (compaction; see the ring section above) -----------------
+
+
+def term_at_rb(log_term, base, base_term, index1):
+    """Batched term_at_r. log_term: [N, CAP, B]; base/base_term/index1: [N, B]."""
+    cap = log_term.shape[1]
+    oh = iota((1, cap, 1), 1) == ((index1 - 1) % cap)[:, None, :]  # [N, CAP, B]
+    got = jnp.sum(jnp.where(oh, log_term, 0), axis=1)
+    return jnp.where(index1 == 0, 0, jnp.where(index1 <= base, base_term, got))
+
+
+def window_rb(arr: jax.Array, start0: jax.Array, e: int) -> jax.Array:
+    """Batched window_r. arr: [N, CAP, B]; start0: [N, B] -> [N, E, B]."""
+    cap = arr.shape[1]
+    pos = (start0[:, None, :] + iota((1, e, 1), 1)) % cap  # [N, E, B]
+    oh = iota((1, 1, cap, 1), 2) == pos[:, :, None, :]  # [N, E, CAP, B]
+    return jnp.sum(jnp.where(oh, arr[:, None], 0), axis=2)
+
+
+def write_window_rb(arr, start0, vals, gate, lo, count):
+    """Batched ring write over the window-slice [lo, count): where gate[n, b],
+    write vals[n, k, b] into slot (start0 + k) mod CAP for lo <= k < count.
+    The extra `lo` bound (vs write_window_b) is the compaction skip: shipped
+    entries at or below the receiver's log_base are already committed and
+    compacted, so the write starts partway into the window. Written positions are
+    distinct mod CAP (retained window <= CAP)."""
+    cap = arr.shape[1]
+    e = vals.shape[1]
+    count = jnp.minimum(jnp.where(gate, count, 0), e).astype(jnp.int32)  # [N, B]
+    lo = jnp.clip(lo, 0, e).astype(jnp.int32)
+    ks = iota((1, e, 1), 1)
+    mask = (ks >= lo[:, None, :]) & (ks < count[:, None, :])  # [N, E, B]
+    pos = jnp.where(mask, (start0[:, None, :] + ks) % cap, cap)
+    oh = iota((1, 1, cap, 1), 2) == pos[:, :, None, :]  # [N, E, CAP, B]
+    rel = (iota((1, cap, 1), 1) - start0[:, None, :]) % cap  # slot's window offset
+    hit = (rel >= lo[:, None, :]) & (rel < count[:, None, :])
+    val = jnp.sum(jnp.where(oh, vals[:, :, None, :], 0), axis=1)
+    return jnp.where(hit, val, arr)
+
+
+def ring_chk_b(log_term, log_val, base, uptos: tuple):
+    """Batched ring_chk. log_term/log_val: [N, CAP, B]; base/uptos: [N, B]."""
+    cap = log_term.shape[1]
+    s = iota((1, cap, 1), 1)
+    abs0 = base[:, None, :] + (s - base[:, None, :]) % cap  # [N, CAP, B]
+    w_t, w_v = chk_weights_at(abs0)
+    contrib = log_term.astype(jnp.uint32) * w_t + log_val.astype(jnp.uint32) * w_v
+    z = jnp.uint32(0)
+    return tuple(
+        jnp.sum(jnp.where(abs0 < u[:, None, :], contrib, z), axis=1, dtype=jnp.uint32)
+        for u in uptos
+    )

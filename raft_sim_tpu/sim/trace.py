@@ -45,12 +45,14 @@ def node_line(states, t: int, node: int) -> str:
     g = lambda f: np.asarray(getattr(states, f))[t, node]
     role = ROLE_NAMES[int(g("role"))]
     vf, ld = int(g("voted_for")), int(g("leader_id"))
+    base = int(g("log_base"))
     return (
         f"  node {node}: {role:<9} term={int(g('term'))}"
         f" voted_for={'-' if vf == NIL else vf}"
         f" leader={'-' if ld == NIL else ld}"
         f" commit={int(g('commit_index'))} log_len={int(g('log_len'))}"
-        f" clock={int(g('clock'))}/{int(g('deadline'))}"
+        + (f" base={base}" if base else "")
+        + f" clock={int(g('clock'))}/{int(g('deadline'))}"
     )
 
 
@@ -59,6 +61,7 @@ def events(states) -> Iterator[tuple[int, str]]:
     role = np.asarray(states.role)
     term = np.asarray(states.term)
     commit = np.asarray(states.commit_index)
+    base = np.asarray(states.log_base)
     n_ticks, n = role.shape
     for t in range(1, n_ticks):
         for i in range(n):
@@ -70,3 +73,5 @@ def events(states) -> Iterator[tuple[int, str]]:
                 yield t, f"node {i} steps down (term {term[t - 1, i]} -> {term[t, i]})"
             if commit[t, i] > commit[t - 1, i]:
                 yield t, f"node {i} commits through {commit[t, i]}"
+            if base[t, i] > base[t - 1, i]:
+                yield t, f"node {i} compacts through {base[t, i]}"

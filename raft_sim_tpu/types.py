@@ -49,6 +49,10 @@ RESP_VOTE = 1  # :vote-response
 RESP_APPEND = 2  # :append-response
 
 NIL = -1  # nil node id
+# Log value of a leader no-op entry (compaction only): appended on election win so
+# a current-term entry exists to pull old-term entries through the spec-5.4.2
+# commit gate (models/raft.py phase 6). Reserved: client commands may not use it.
+NOOP = -2
 
 # Packed response word (Mailbox.resp_word): type (2 bits) | ok << 2 | match << 3.
 # Both kernels and the checkpoint format share this layout through pack_resp/
@@ -57,20 +61,33 @@ NIL = -1  # nil node id
 RESP_TYPE_MASK = 3
 RESP_OK_SHIFT = 2
 RESP_MATCH_SHIFT = 3
-# Static bit-budget tie: resp_word is int16, so after 2 type bits + 1 ok bit the
-# packed match index gets 12 value bits + nothing to spare above the sign bit.
-# The largest packable match is the log-capacity ceiling enforced at config
-# construction -- the packing sits at exactly that limit, asserted here so
+# Static bit-budget tie (narrow mode): resp_word is int16, so after 2 type bits +
+# 1 ok bit the packed match index gets 12 value bits + nothing to spare above the
+# sign bit. The largest packable match is the log-capacity ceiling enforced at
+# config construction -- the packing sits at exactly that limit, asserted here so
 # widening MAX_LOG_CAPACITY without widening resp_word is an import-time error.
+# Compaction configs carry ABSOLUTE (unbounded) log indices and ride the wide
+# int32 word instead: after 2 type bits + 1 ok bit and the sign bit, the packed
+# match gets 28 value bits, so runs are bounded at 2^28 ~ 268M committed entries
+# per node (the shift-by-3 of a larger match would set the sign bit and corrupt
+# the arithmetic-shift unpack).
 assert (MAX_LOG_CAPACITY << RESP_MATCH_SHIFT) + (1 << RESP_OK_SHIFT) + RESP_TYPE_MASK < 2**15
 
 
-def pack_resp(rtype, ok, match):
-    """Pack (type, ok, match) into the int16 response word. `ok` may be bool or
-    0/1 int; `match` is a log index in [0, MAX_LOG_CAPACITY]."""
+def index_dtype(cfg: RaftConfig):
+    """Dtype of the per-edge log-index planes (next/match) and the packed response
+    word. int16 when indices are bounded by log_capacity <= 4095; int32 when
+    compaction makes indices absolute and unbounded."""
+    return jnp.int32 if cfg.compaction else jnp.int16
+
+
+def pack_resp(rtype, ok, match, wide: bool = False):
+    """Pack (type, ok, match) into the response word -- int16 (`match` a log index
+    in [0, MAX_LOG_CAPACITY]) or int32 when `wide` (compaction: absolute indices).
+    `ok` may be bool or 0/1 int."""
     ok = jnp.asarray(ok).astype(jnp.int32)
     return (rtype + (ok << RESP_OK_SHIFT) + (match << RESP_MATCH_SHIFT)).astype(
-        jnp.int16
+        jnp.int32 if wide else jnp.int16
     )
 
 
@@ -125,13 +142,21 @@ class Mailbox(NamedTuple):
     req_commit: jax.Array  # [N] int32: AE leaderCommit
     req_last_index: jax.Array  # [N] int32: RV lastLogIndex
     req_last_term: jax.Array  # [N] int32: RV lastLogTerm
-    ent_start: jax.Array  # [N] int32: 0-based slot where src's shared window starts
+    ent_start: jax.Array  # [N] int32: 1-based index before src's shared window (= prev at j=0)
     ent_prev_term: jax.Array  # [N] int32: term of the 1-based entry ent_start (j=0 prev)
     ent_count: jax.Array  # [N] int32: entries shipped = min(log_len - ent_start, E)
     ent_term: jax.Array  # [N, E] int32: src's shared entry window (terms)
     ent_val: jax.Array  # [N, E] int32: src's shared entry window (values)
-    req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E
-    resp_word: jax.Array  # [N(receiver), N(responder)] int16: type | ok<<2 | match<<3
+    # Snapshot header (compaction only; zeros otherwise): an AE sender's compaction
+    # state (lastIncludedIndex/-Term + the checksum of the compacted prefix). An
+    # edge whose req_off is the SNAP sentinel -1 is an InstallSnapshot analogue:
+    # the receiver installs (req_base, req_base_term, req_base_chk) instead of
+    # appending entries (models/raft.py phase 3).
+    req_base: jax.Array  # [N] int32: sender's log_base (snapshot lastIncludedIndex)
+    req_base_term: jax.Array  # [N] int32: snapshot lastIncludedTerm
+    req_base_chk: jax.Array  # [N] uint32: checksum of the compacted prefix
+    req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E; -1 = snapshot
+    resp_word: jax.Array  # [N(receiver), N(responder)] int16/int32 (index_dtype): type | ok<<2 | match<<3
     resp_term: jax.Array  # [N(responder)] int32: responder's term at send time
 
 
@@ -154,9 +179,10 @@ class ClusterState(NamedTuple):
     votes: jax.Array  # [N, N] bool; votes[i, j] = i holds a granted vote from j
     # The three [N, N] leader-bookkeeping planes are the largest state after the
     # mailbox; log indices fit int16 (config asserts log_capacity <= 4095) and ages
-    # saturate (ACK_AGE_SAT), halving their HBM traffic vs int32.
-    next_index: jax.Array  # [N, N] int16; leader i's next index for peer j
-    match_index: jax.Array  # [N, N] int16
+    # saturate (ACK_AGE_SAT), halving their HBM traffic vs int32. Compaction
+    # configs carry absolute (unbounded) indices: int32 (index_dtype).
+    next_index: jax.Array  # [N, N] int16/int32; leader i's next index for peer j
+    match_index: jax.Array  # [N, N] int16/int32
     # Ticks since leader i last received an AppendEntries response (success OR
     # failure -- both prove the peer is up) from peer j, saturating at ACK_AGE_SAT;
     # zeroed for the whole row when i wins an election (grace period). Volatile
@@ -171,6 +197,17 @@ class ClusterState(NamedTuple):
     # states that set commit_index directly must refresh it via
     # types.with_commit_chk (the invariant trips otherwise -- by design).
     commit_chk: jax.Array  # [N] uint32
+    # Compaction state (all zeros when cfg.compact_margin == 0). Entries 1..log_base
+    # have been compacted away: they exist only as this triple (the snapshot). The
+    # Raft persistent set grows to include it (a restart keeps base and resumes with
+    # commit = log_base). Invariant: log_base <= commit_index <= log_len and
+    # log_len - log_base <= CAP (the retained window fits the ring).
+    log_base: jax.Array  # [N] int32: snapshot lastIncludedIndex
+    base_term: jax.Array  # [N] int32: snapshot lastIncludedTerm
+    base_chk: jax.Array  # [N] uint32: checksum of entries 1..log_base
+    # Ring log: 1-based entry i lives at slot (i - 1) mod CAP; live slots hold
+    # entries (log_base, log_len]. With compaction off, log_base == 0 and the ring
+    # degenerates to the plain prefix layout (entry i at slot i-1, log_len <= CAP).
     log_term: jax.Array  # [N, CAP] int32
     log_val: jax.Array  # [N, CAP] int32
     log_len: jax.Array  # [N] int32
@@ -223,8 +260,11 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         ent_count=i(n),
         ent_term=i(n, e),
         ent_val=i(n, e),
+        req_base=i(n),
+        req_base_term=i(n),
+        req_base_chk=jnp.zeros((n,), jnp.uint32),
         req_off=jnp.zeros((n, n), jnp.int8),
-        resp_word=jnp.zeros((n, n), jnp.int16),
+        resp_word=jnp.zeros((n, n), index_dtype(cfg)),
         resp_term=i(n),
     )
 
@@ -234,6 +274,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
     Log.start log.clj:32-34) and randomized initial election deadlines (the reference
     randomizes per wait-loop iteration, core.clj:174)."""
     n, cap = cfg.n_nodes, cfg.log_capacity
+    idt = index_dtype(cfg)
     deadline = draw_timeouts(cfg, key, n)
     return ClusterState(
         role=jnp.full((n,), FOLLOWER, jnp.int32),
@@ -241,11 +282,14 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         voted_for=jnp.full((n,), NIL, jnp.int32),
         leader_id=jnp.full((n,), NIL, jnp.int32),
         votes=jnp.zeros((n, n), bool),
-        next_index=jnp.ones((n, n), jnp.int16),
-        match_index=jnp.zeros((n, n), jnp.int16),
+        next_index=jnp.ones((n, n), idt),
+        match_index=jnp.zeros((n, n), idt),
         ack_age=jnp.full((n, n), ACK_AGE_SAT, jnp.int16),
         commit_index=jnp.zeros((n,), jnp.int32),
         commit_chk=jnp.zeros((n,), jnp.uint32),
+        log_base=jnp.zeros((n,), jnp.int32),
+        base_term=jnp.zeros((n,), jnp.int32),
+        base_chk=jnp.zeros((n,), jnp.uint32),
         log_term=jnp.zeros((n, cap), jnp.int32),
         log_val=jnp.zeros((n, cap), jnp.int32),
         log_len=jnp.zeros((n,), jnp.int32),
@@ -258,13 +302,14 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
 
 def with_commit_chk(state: ClusterState) -> ClusterState:
     """Refresh commit_chk from the current log arrays + commit_index (single-cluster
-    state). For tests and state surgery that set commit_index by hand."""
+    state). For tests and state surgery that set commit_index by hand. Ring-aware:
+    states with log_base > 0 must carry a correct base_chk already."""
     from raft_sim_tpu.ops import log_ops
 
-    chk, _ = log_ops.prefix_chk2(
-        state.log_term, state.log_val, state.commit_index, state.commit_index
+    (live,) = log_ops.ring_chk(
+        state.log_term, state.log_val, state.log_base, (state.commit_index,)
     )
-    return state._replace(commit_chk=chk)
+    return state._replace(commit_chk=state.base_chk + live)
 
 
 def init_batch(cfg: RaftConfig, key: jax.Array, batch: int) -> ClusterState:

@@ -37,7 +37,10 @@ from raft_sim_tpu.utils.config import RaftConfig
 # v8: narrow dtypes (next/match int16, req_off int8, resp_word int16) and last_ack
 #     replaced by the saturating int16 ack_age.
 # v9: ClusterState gained commit_chk (committed-prefix checksum).
-_FORMAT_VERSION = 9
+# v10: ring-log compaction -- ClusterState gained log_base/base_term/base_chk,
+#      Mailbox gained the snapshot header (req_base/req_base_term/req_base_chk);
+#      compaction configs widen next/match and resp_word to int32.
+_FORMAT_VERSION = 10
 
 
 def _normalize(path: str) -> str:

@@ -86,6 +86,22 @@ class RaftConfig:
     # trip so a live peer is never spuriously excluded by ordinary heartbeat cadence.
     ack_timeout_ticks: int = 12
 
+    # Log compaction / snapshotting. The reference's log is an unbounded vector
+    # (log.clj:33, append at log.clj:61-67): a reference cluster accepts client
+    # writes forever. 0 (default) keeps the fixed-capacity log: once full, commands
+    # are rejected permanently. > 0 turns the [N, CAP] arrays into a RING over
+    # absolute 1-based indices (entry i at slot (i-1) mod CAP) and each node
+    # compacts its committed prefix: whenever the retained window
+    # (log_len - log_base) exceeds CAP - compact_margin, log_base advances toward
+    # commit_index, freeing slots so appends can wrap -- unbounded-horizon client
+    # workloads never exhaust the log. Entries below log_base live on only as
+    # (log_base, base_term, base_chk); leaders whose peer's next_index falls below
+    # their base send an InstallSnapshot analogue instead of entries
+    # (models/raft.py phase 3/8). Compaction configs carry absolute indices, so
+    # the int16 next/match planes and the 12-bit packed response match widen to
+    # int32 (types.index_dtype).
+    compact_margin: int = 0
+
     # Client command injection (reference: external curl POST /client-set,
     # server.clj:8-12, core.clj:151-160). Every `client_interval` ticks one command is
     # offered to each cluster's current leader; 0 disables.
@@ -113,6 +129,16 @@ class RaftConfig:
         if self.crash_prob > 0:
             assert self.crash_period >= 2
             assert 1 <= self.crash_down_ticks <= self.crash_period
+        # Compaction slack: client injections stop max(1, margin // 2) slots short
+        # of the ring so election no-ops always find room (models/raft.py phase 6);
+        # margin >= 2 keeps that client ceiling above the steady-state retained
+        # window (CAP - margin), and the margin must not consume the whole ring.
+        assert self.compact_margin == 0 or 2 <= self.compact_margin < self.log_capacity
+
+    @property
+    def compaction(self) -> bool:
+        """True when the ring-log compaction path is active (compact_margin > 0)."""
+        return self.compact_margin > 0
 
     @property
     def quorum(self) -> int:
@@ -160,5 +186,25 @@ PRESETS: dict[str, tuple[RaftConfig, int]] = {
             check_invariants=True,
         ),
         10_000,
+    ),
+    # Not a BASELINE row: the ring-compaction acceptance preset. A deliberately
+    # small ring under an unbounded client workload (one command per 4 ticks
+    # forever) plus crash + drop faults: run >= 100k ticks, commands must keep
+    # being accepted (commit passes many multiples of CAP) with zero violations.
+    # The reference passes this trivially (unbounded log vector, log.clj:33); the
+    # fixed-CAP log without compaction fails it by construction.
+    "config6": (
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=32,
+            compact_margin=8,
+            max_entries_per_rpc=4,
+            client_interval=4,
+            drop_prob=0.1,
+            crash_prob=0.3,
+            crash_period=64,
+            crash_down_ticks=12,
+        ),
+        1_000,
     ),
 }

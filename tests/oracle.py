@@ -26,6 +26,7 @@ NIL = -1
 # import from raft_sim_tpu); tests/test_constants.py pins them against the originals
 # so they cannot drift silently.
 ACK_AGE_SAT = 30000  # raft_sim_tpu.utils.config.ACK_AGE_SAT
+NOOP = -2  # raft_sim_tpu.types.NOOP (leader no-op entry value, compaction only)
 
 
 def chk_weights(k: int) -> tuple[int, int]:
@@ -67,6 +68,18 @@ def term_at(log_term: np.ndarray, index1: int) -> int:
     return int(log_term[min(index1 - 1, cap - 1)])
 
 
+def term_at_ring(log_term: np.ndarray, base: int, base_term: int, index1: int) -> int:
+    """Ring-aware term_at: 1-based entry index1 from slot (index1 - 1) mod CAP when
+    live (index1 > base); base_term for the compacted prefix; 0 for no entry.
+    Degenerates to term_at for base == 0 within the live range."""
+    if index1 == 0:
+        return 0
+    if index1 <= base:
+        return int(base_term)
+    cap = log_term.shape[0]
+    return int(log_term[(index1 - 1) % cap])
+
+
 def oracle_step(cfg, s: dict, inp: dict) -> dict:
     """One tick for one cluster; returns a fresh state dict."""
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
@@ -82,6 +95,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     ack_age = s["ack_age"].copy()
     commit = s["commit_index"].copy()
     commit_chk = s["commit_chk"].copy()
+    log_base = s["log_base"].copy()
+    base_term = s["base_term"].copy()
+    base_chk = s["base_chk"].copy()
     log_term = s["log_term"].copy()
     log_val = s["log_val"].copy()
     log_len = s["log_len"].copy()
@@ -90,7 +106,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     alive = np.asarray(inp["alive"], bool)
     restarted = np.asarray(inp["restarted"], bool)
 
-    # ---- phase -1: restart wipe (persistent term/vote/log survive; volatile wiped)
+    # ---- phase -1: restart wipe (persistent term/vote/log -- including the
+    # snapshot triple -- survive; volatile wiped; commit resumes at the base)
     for d in range(n):
         if restarted[d]:
             role[d] = FOLLOWER
@@ -99,8 +116,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             next_index[d, :] = 1
             match_index[d, :] = 0
             ack_age[d, :] = ACK_AGE_SAT
-            commit[d] = 0
-            commit_chk[d] = 0
+            commit[d] = log_base[d]
+            commit_chk[d] = base_chk[d]
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
 
     # ---- phase 0: delivery
@@ -138,7 +155,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     vr_granted = np.zeros((n, n), bool)
     for d in range(n):
         my_last_idx = int(s["log_len"][d])
-        my_last_term = term_at(s["log_term"][d], my_last_idx)
+        my_last_term = term_at_ring(
+            s["log_term"][d], int(log_base[d]), int(base_term[d]), my_last_idx
+        )
         can = []
         for src in range(n):
             if not (req_in[src, d] and mb["req_type"][src] == REQ_VOTE):
@@ -165,8 +184,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             granted_any[d] = True
             voted_for[d] = winner
 
-    # ---- phase 3: AppendEntries requests
+    # ---- phase 3: AppendEntries requests (incl. the InstallSnapshot analogue)
     has_ae = np.zeros(n, bool)
+    snap_applied = np.zeros(n, bool)
     ar_out = np.zeros((n, n), bool)
     ar_success = np.zeros((n, n), bool)
     ar_match = np.zeros((n, n), np.int32)
@@ -188,11 +208,32 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             role[d] = FOLLOWER
         leader_id[d] = src
 
+        j = int(mb["req_off"][src, d])
+        if j < 0:
+            # InstallSnapshot analogue (req_off sentinel -1): install the sender's
+            # compaction base. If our log extends through L with the snapshot's
+            # term, retain the suffix; else discard the log. L <= our base needs
+            # nothing. Always ack with match = L.
+            L = int(mb["req_base"][src])
+            if L > int(log_base[d]):
+                keep = L <= int(log_len[d]) and term_at_ring(
+                    log_term[d], int(log_base[d]), int(base_term[d]), L
+                ) == int(mb["req_base_term"][src])
+                base_term[d] = int(mb["req_base_term"][src])
+                base_chk[d] = mb["req_base_chk"][src]
+                log_base[d] = L
+                if not keep:
+                    log_len[d] = L
+                commit[d] = max(int(commit[d]), L)
+                snap_applied[d] = True
+            ar_success[d, src] = True
+            ar_match[d, src] = L
+            continue
+
         # Reconstruct the per-edge AE header from the sender's broadcast record plus
         # this edge's window offset j (Mailbox docstring): prev = ent_start + j,
         # prev term = ent_prev_term for j == 0 else window slot j-1, and n_entries =
         # whatever of the window lies past j.
-        j = int(mb["req_off"][src, d])
         ws = int(mb["ent_start"][src])
         prev_i = ws + j
         prev_t = (
@@ -205,32 +246,45 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         ent_t = [int(mb["ent_term"][src, min(j + k, e - 1)]) for k in range(e)]
         ent_v = [int(mb["ent_val"][src, min(j + k, e - 1)]) for k in range(e)]
 
-        consistent = prev_i == 0 or (
+        b = int(log_base[d])
+        # prev below our base is committed-and-compacted: consistent by leader
+        # completeness; at prev == base the check compares against base_term.
+        consistent = prev_i == 0 or prev_i < b or (
             prev_i <= int(s["log_len"][d])
-            and term_at(s["log_term"][d], prev_i) == prev_t
+            and term_at_ring(log_term[d], b, int(base_term[d]), prev_i) == prev_t
         )
         if not consistent:
             continue
 
+        # Skip entries already compacted (<= base), accept only what the ring can
+        # hold (<= base + CAP).
+        lo = min(max(b - prev_i, 0), e)
+        n_acc = min(n_ent, max(b + cap - prev_i, 0))
         any_mismatch = any(
-            k < n_ent
+            lo <= k < n_acc
             and prev_i + k < int(s["log_len"][d])
-            and int(s["log_term"][d][prev_i + k]) != int(ent_t[k])
+            and int(log_term[d, (prev_i + k) % cap]) != int(ent_t[k])
             for k in range(e)
         )
-        appended_len = min(prev_i + n_ent, cap)
+        appended_len = prev_i + n_acc
         new_len = appended_len if any_mismatch else max(int(s["log_len"][d]), appended_len)
-        for k in range(n_ent):
-            pos = prev_i + k
-            if pos < cap:
-                log_term[d, pos] = ent_t[k]
-                log_val[d, pos] = ent_v[k]
+        for k in range(lo, n_acc):
+            log_term[d, (prev_i + k) % cap] = ent_t[k]
+            log_val[d, (prev_i + k) % cap] = ent_v[k]
         log_len[d] = new_len
 
-        last_new = min(prev_i + n_ent, new_len)
+        last_new = min(prev_i + n_acc, new_len)
         commit[d] = max(int(commit[d]), min(lcommit, last_new))
         ar_success[d, src] = True
         ar_match[d, src] = last_new
+
+    # NACK catch-up hint: every unsuccessful AE response carries the responder's
+    # (post-append) log length in its match field -- the conflict-index
+    # optimization (raft.py phase 3).
+    for d in range(n):
+        for src in range(n):
+            if ar_out[d, src] and not ar_success[d, src]:
+                ar_match[d, src] = log_len[d]
 
     # ---- phase 4: responses
     # Everyone's ack age grows one tick (saturating); stamps below zero it.
@@ -269,7 +323,11 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 match_index[d, src] = max(int(match_index[d, src]), m)
                 next_index[d, src] = max(int(next_index[d, src]), m + 1)
             else:
-                next_index[d, src] = max(int(next_index[d, src]) - 1, 1)
+                # Back off to min(next-1, hint+1): the nack's match field is the
+                # responder's log length (conflict-index hint, raft.py phase 4).
+                next_index[d, src] = max(
+                    min(int(next_index[d, src]) - 1, int(r_match[d, src]) + 1), 1
+                )
             # Any AE response (success or failure) proves the peer is up.
             ack_age[d, src] = 0
 
@@ -280,15 +338,63 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         match = match_index[d].copy()
         match[d] = log_len[d]
         quorum_match = int(np.sort(match)[::-1][cfg.quorum - 1])
-        if quorum_match > commit[d] and term_at(log_term[d], quorum_match) == term[d]:
+        if quorum_match > commit[d] and term_at_ring(
+            log_term[d], int(log_base[d]), int(base_term[d]), quorum_match
+        ) == term[d]:
             commit[d] = quorum_match
 
-    # ---- phase 6: client injection
+    # ---- phase 5.5: log compaction (advance base toward commit when fewer than
+    # compact_margin free ring slots remain; base_chk extends in the checksum pass)
+    base_mid = log_base.copy()
+    base_chk_mid = base_chk.copy()
+    if cfg.compact_margin > 0:
+        for d in range(n):
+            target = min(int(commit[d]), int(log_len[d]) - (cap - cfg.compact_margin))
+            if target > int(log_base[d]):
+                base_term[d] = term_at_ring(
+                    log_term[d], int(log_base[d]), int(base_term[d]), target
+                )
+                log_base[d] = target
+
+    # ---- committed-prefix checksum (log_ops.chk_weights analogue): weights by
+    # ABSOLUTE entry index, anchored at the pre-compaction base (base_mid); the
+    # same pass extends base_chk over the newly compacted span. Runs BEFORE
+    # injection -- a write into a slot freed by this tick's rebase would alias
+    # under the anchored slot->index map (raft.py). Under compaction the sums are
+    # maintained even with invariant checking off (base_chk is wire state).
+    if cfg.check_invariants or cfg.compact_margin > 0:
+        M = (1 << 32) - 1
+        for d in range(n):
+            acc = int(base_chk_mid[d])
+            accb = int(base_chk_mid[d])
+            for a in range(int(base_mid[d]), int(commit[d])):  # 0-based abs index
+                w_t, w_v = chk_weights(a)
+                contrib = int(log_term[d, a % cap]) * w_t + int(log_val[d, a % cap]) * w_v
+                acc = (acc + contrib) & M
+                if a < int(log_base[d]):
+                    accb = (accb + contrib) & M
+            commit_chk[d] = np.uint32(acc)
+            base_chk[d] = np.uint32(accb)
+
+    # ---- phase 6: client injection (ring slot; space = retained window < CAP),
+    # plus the election-win leader no-op under compaction (raft.py phase 6)
     cmd = int(inp["client_cmd"])
+    comp = cfg.compact_margin > 0
+    reserve = max(1, cfg.compact_margin // 2)
     for d in range(n):
-        if cmd != NIL and role[d] == LEADER and alive[d] and log_len[d] < cap:
-            log_term[d, log_len[d]] = term[d]
-            log_val[d, log_len[d]] = cmd
+        retained = int(log_len[d]) - int(log_base[d])
+        if comp and win[d] and retained < cap:
+            log_term[d, log_len[d] % cap] = term[d]
+            log_val[d, log_len[d] % cap] = NOOP
+            log_len[d] += 1
+        elif (
+            cmd != NIL
+            and role[d] == LEADER
+            and alive[d]
+            and retained < (cap - reserve if comp else cap)
+        ):
+            log_term[d, log_len[d] % cap] = term[d]
+            log_val[d, log_len[d] % cap] = cmd
             log_len[d] += 1
 
     # ---- phase 7: timers
@@ -314,16 +420,6 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, d] = True
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
 
-    # ---- committed-prefix checksum (log_ops.chk_weights analogue, chk_weights above)
-    if cfg.check_invariants:
-        M = (1 << 32) - 1
-        for d in range(n):
-            acc = 0
-            for k in range(int(commit[d])):
-                w_t, w_v = chk_weights(k)
-                acc = (acc + int(log_term[d, k]) * w_t + int(log_val[d, k]) * w_v) & M
-            commit_chk[d] = np.uint32(acc)
-
     # ---- phase 8: outbox (wire format v8: per-sender headers + per-edge offsets)
     z = lambda *shape: np.zeros(shape, np.int32)
     out = {
@@ -337,22 +433,29 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "ent_count": z(n),
         "ent_term": z(n, e),
         "ent_val": z(n, e),
+        "req_base": z(n),
+        "req_base_term": z(n),
+        "req_base_chk": np.zeros(n, np.uint32),
         "req_off": z(n, n),
         "resp_word": z(n, n),
         "resp_term": z(n),
     }
     for src in range(n):
         out["resp_term"][src] = term[src]
+        b = int(log_base[src])
+        bt = int(base_term[src])
         if start_election[src]:
             last_idx = int(log_len[src])
             out["req_type"][src] = REQ_VOTE
             out["req_term"][src] = term[src]
             out["req_last_index"][src] = last_idx
-            out["req_last_term"][src] = term_at(log_term[src], last_idx)
+            out["req_last_term"][src] = term_at_ring(log_term[src], b, bt, last_idx)
         elif win[src] or heartbeat[src]:
             # Shared entry window: starts at the minimum prev over RESPONSIVE peers
             # (acked an AE within ack_timeout_ticks), falling back to all peers when
-            # none are -- a dead peer must not pin the window (raft.py phase 8).
+            # none are -- a dead peer must not pin the window (raft.py phase 8) --
+            # and never below the compaction base (those entries are gone; such
+            # peers get the InstallSnapshot sentinel instead).
             prev_of = lambda dst: min(
                 max(int(next_index[src, dst]) - 1, 0), int(log_len[src])
             )
@@ -363,23 +466,32 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             ]
             all_prevs = [prev_of(dst) for dst in range(n) if dst != src]
             ws = min(min(resp_prevs or all_prevs), int(log_len[src]))
+            ws = max(ws, b)
             n_ship = min(int(log_len[src]) - ws, e)
             out["req_type"][src] = REQ_APPEND
             out["req_term"][src] = term[src]
             out["req_commit"][src] = commit[src]
             out["ent_start"][src] = ws
-            out["ent_prev_term"][src] = term_at(log_term[src], ws)
+            out["ent_prev_term"][src] = term_at_ring(log_term[src], b, bt, ws)
             out["ent_count"][src] = n_ship
+            out["req_base"][src] = b
+            out["req_base_term"][src] = bt
+            out["req_base_chk"][src] = base_chk[src]
             for k in range(n_ship):
-                out["ent_term"][src, k] = log_term[src, ws + k]
-                out["ent_val"][src, k] = log_val[src, ws + k]
+                out["ent_term"][src, k] = log_term[src, (ws + k) % cap]
+                out["ent_val"][src, k] = log_val[src, (ws + k) % cap]
             for dst in range(n):
                 if dst == src:
                     continue
                 # Per-edge offset j = prev - ws, with prev clamped into [ws, ws+E]
                 # (a peer ahead of the window gets a heartbeat over an older prefix;
-                # an unresponsive laggard's prev is lifted to the window start).
-                out["req_off"][src, dst] = min(max(prev_of(dst), ws), ws + e) - ws
+                # an unresponsive laggard's prev is lifted to the window start); a
+                # peer whose prev fell below the base gets the snapshot sentinel.
+                p = prev_of(dst)
+                if p < b:
+                    out["req_off"][src, dst] = -1
+                else:
+                    out["req_off"][src, dst] = min(max(p, ws), ws + e) - ws
     # Responses travel back src<->dst: responder r answers requester q.
     for r in range(n):
         for q in range(n):
@@ -403,6 +515,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "ack_age": ack_age,
         "commit_index": commit,
         "commit_chk": commit_chk,
+        "log_base": log_base,
+        "base_term": base_term,
+        "base_chk": base_chk,
         "log_term": log_term,
         "log_val": log_val,
         "log_len": log_len,

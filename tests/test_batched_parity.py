@@ -36,6 +36,22 @@ CONFIGS = [
         ),
         id="n5-crashes",
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            max_entries_per_rpc=2,
+            client_interval=1,
+            drop_prob=0.2,
+            crash_prob=0.5,
+            crash_period=20,
+            crash_down_ticks=12,
+            check_log_matching=True,
+        ),
+        id="n5-compaction-snap",  # ring wrap + rebase + InstallSnapshot sentinel,
+        # wide (int32) index planes, ring-aware log-matching check
+    ),
 ]
 
 

@@ -1,0 +1,422 @@
+"""Ring-log compaction + snapshot catch-up (cfg.compact_margin > 0).
+
+The reference's log is an unbounded Clojure vector (log.clj:33, append at
+log.clj:61-67): a reference cluster accepts client writes forever. The fixed-CAP
+array log must therefore compact its committed prefix (advance log_base) and give
+laggards an InstallSnapshot analogue (req_off sentinel -1 installing
+base/base_term/base_chk) or long-horizon client workloads would exhaust it. These
+tests pin every new transition at the handler level (hand-built states, one tick)
+plus a CI-sized unbounded-horizon liveness run; tests/test_oracle_parity.py and
+tests/test_batched_parity.py pin the same semantics against the oracle and the
+batch-minor kernel across random trajectories.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_sim_tpu import FOLLOWER, LEADER, NIL, RaftConfig, StepInputs, init_state
+from raft_sim_tpu.sim import scan
+from raft_sim_tpu.types import REQ_APPEND
+from tests import oracle as orc
+from tests.test_handlers import (
+    ae_wire,
+    base_state,
+    quiet_inputs,
+    resp_match_of,
+    resp_ok_of,
+    step,
+)
+
+M32 = (1 << 32) - 1
+
+# Ring of 8 slots, compaction keeps >= 2 free (retain target 6).
+CFG = RaftConfig(n_nodes=5, log_capacity=8, compact_margin=2, max_entries_per_rpc=4)
+
+
+def chk_of(entries, start0=0):
+    """Checksum of consecutive (term, val) entries at absolute 0-based indices
+    start0... -- stated via the oracle's independent weight formula."""
+    acc = 0
+    for i, (t, v) in enumerate(entries):
+        w_t, w_v = orc.chk_weights(start0 + i)
+        acc = (acc + t * w_t + v * w_v) & M32
+    return acc
+
+
+def hist(a, b):
+    """The canonical synthetic history: absolute 1-based entry i is (term 1,
+    value 1000 + i). Returns entries for indices a+1..b."""
+    return [(1, 1000 + i) for i in range(a + 1, b + 1)]
+
+
+def hist_chk(upto):
+    return chk_of(hist(0, upto))
+
+
+def with_ring_log(s, node, base, entries, commit, base_term=1):
+    """Install a compacted ring log on `node`: `entries` are (term, val) for
+    absolute indices base+1..base+len(entries); checksums are derived as if the
+    compacted prefix were the canonical hist()."""
+    cap = CFG.log_capacity
+    lt, lv = s.log_term, s.log_val
+    for k, (t, v) in enumerate(entries):
+        slot = (base + k) % cap
+        lt = lt.at[node, slot].set(t)
+        lv = lv.at[node, slot].set(v)
+    bchk = hist_chk(base)
+    cchk = (bchk + chk_of(entries[: commit - base], start0=base)) & M32
+    return s._replace(
+        log_term=lt,
+        log_val=lv,
+        log_len=s.log_len.at[node].set(base + len(entries)),
+        log_base=s.log_base.at[node].set(base),
+        base_term=s.base_term.at[node].set(base_term if base else 0),
+        base_chk=s.base_chk.at[node].set(np.uint32(bchk if base else 0)),
+        commit_index=s.commit_index.at[node].set(commit),
+        commit_chk=s.commit_chk.at[node].set(np.uint32(cchk)),
+    )
+
+
+def snap_wire(s, src, term, L, Lt, Lchk):
+    """Broadcast an InstallSnapshot analogue from `src`: an AppendEntries whose
+    every edge carries the req_off sentinel -1 plus the snapshot header."""
+    mb = s.mailbox._replace(
+        req_type=s.mailbox.req_type.at[src].set(REQ_APPEND),
+        req_term=s.mailbox.req_term.at[src].set(term),
+        req_commit=s.mailbox.req_commit.at[src].set(L),
+        req_base=s.mailbox.req_base.at[src].set(L),
+        req_base_term=s.mailbox.req_base_term.at[src].set(Lt),
+        req_base_chk=s.mailbox.req_base_chk.at[src].set(jnp.uint32(Lchk)),
+        req_off=s.mailbox.req_off.at[src, :].set(-1),
+    )
+    return s._replace(mailbox=mb)
+
+
+def leader(s, node, term, next_to=None):
+    """Minimal leader fixture (wide-index variant of test_handlers.make_leader)."""
+    n = CFG.n_nodes
+    nxt = int(s.log_len[node]) + 1 if next_to is None else next_to
+    return s._replace(
+        role=s.role.at[node].set(LEADER),
+        term=s.term.at[node].set(term),
+        leader_id=jnp.full((n,), node, jnp.int32),
+        next_index=s.next_index.at[node].set(
+            jnp.full((n,), nxt, s.next_index.dtype)
+        ),
+        ack_age=s.ack_age.at[node].set(jnp.zeros((n,), jnp.int16)),
+    )
+
+
+# --------------------------------------------------------------- snapshot install
+
+
+def test_snapshot_install_wipe():
+    """A fresh follower receiving a snapshot adopts it wholesale: log becomes
+    logically empty at base = L, commit = L, checksums = the leader's."""
+    L, Lchk = 10, hist_chk(10)
+    s = base_state(CFG)
+    s = s._replace(term=s.term.at[1].set(2))
+    s = snap_wire(s, 0, term=2, L=L, Lt=1, Lchk=Lchk)
+    s2, info = step(CFG, s)
+    assert int(s2.log_base[1]) == L
+    assert int(s2.log_len[1]) == L
+    assert int(s2.commit_index[1]) == L
+    assert int(s2.base_term[1]) == 1
+    assert int(np.uint32(s2.base_chk[1])) == Lchk
+    assert int(np.uint32(s2.commit_chk[1])) == Lchk
+    assert int(s2.leader_id[1]) == 0
+    assert resp_ok_of(s2.mailbox, 0, 1)
+    assert resp_match_of(s2.mailbox, 0, 1) == L
+    assert not bool(info.viol_commit)
+
+
+def test_snapshot_install_keep_retains_suffix():
+    """If the follower's log extends through L with the snapshot's term, the
+    suffix past L is retained (Raft fig. 13 rule 6)."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 1, base=4, entries=hist(4, 12), commit=6)
+    s = s._replace(term=s.term.at[1].set(2))
+    s = snap_wire(s, 0, term=2, L=8, Lt=1, Lchk=hist_chk(8))
+    s2, info = step(CFG, s)
+    assert int(s2.log_base[1]) == 8
+    assert int(s2.log_len[1]) == 12  # suffix retained
+    assert int(s2.commit_index[1]) == 8
+    assert int(np.uint32(s2.base_chk[1])) == hist_chk(8)
+    # entries 9..12 still live in the ring
+    for i in range(9, 13):
+        assert int(s2.log_val[1, (i - 1) % CFG.log_capacity]) == 1000 + i
+    assert resp_match_of(s2.mailbox, 0, 1) == 8
+    assert not bool(info.viol_commit)
+
+
+def test_snapshot_install_wipe_on_conflict():
+    """A conflicting entry at L (different term) discards the whole log."""
+    s = base_state(CFG)
+    ents = hist(0, 6) + [(2, 99), (2, 98)]  # entries 7, 8 from term 2
+    s = with_ring_log(s, 1, base=0, entries=ents, commit=4)
+    s = s._replace(term=s.term.at[1].set(3))
+    s = snap_wire(s, 0, term=3, L=8, Lt=1, Lchk=hist_chk(8))
+    s2, info = step(CFG, s)
+    assert int(s2.log_base[1]) == 8
+    assert int(s2.log_len[1]) == 8  # suffix discarded (term 2 entry conflicted)
+    assert int(s2.commit_index[1]) == 8
+    assert int(np.uint32(s2.commit_chk[1])) == hist_chk(8)
+    assert not bool(info.viol_commit)
+
+
+def test_snapshot_below_base_is_plain_ack():
+    """L at or below our base installs nothing but still acks (the leader's
+    match/next then walk forward past the snapshot)."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 1, base=8, entries=hist(8, 10), commit=9)
+    s = s._replace(term=s.term.at[1].set(2))
+    s = snap_wire(s, 0, term=2, L=6, Lt=1, Lchk=hist_chk(6))
+    s2, info = step(CFG, s)
+    assert int(s2.log_base[1]) == 8  # unchanged
+    assert int(s2.log_len[1]) == 10
+    assert int(s2.commit_index[1]) == 9
+    assert resp_ok_of(s2.mailbox, 0, 1)
+    assert resp_match_of(s2.mailbox, 0, 1) == 6
+    assert not bool(info.viol_commit)
+
+
+# ------------------------------------------------------------------- ring appends
+
+
+def test_ring_append_wraps_past_capacity():
+    """Appending at prev == base (boundary consistency via base_term) wraps
+    physical slots: entries 7..10 of an 8-ring land at slots 6, 7, 0, 1."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 1, base=6, entries=[], commit=6)
+    s = s._replace(term=s.term.at[1].set(2))
+    ents = [(2, 71), (2, 72), (2, 73), (2, 74)]  # abs 7..10
+    s = ae_wire(s, 0, term=2, prev_i=6, prev_t=1, commit=6, ents=ents)
+    s2, info = step(CFG, s)
+    assert int(s2.log_len[1]) == 10
+    assert resp_ok_of(s2.mailbox, 0, 1)
+    assert resp_match_of(s2.mailbox, 0, 1) == 10
+    cap = CFG.log_capacity
+    for i, (_, v) in zip(range(7, 11), ents):
+        assert int(s2.log_val[1, (i - 1) % cap]) == v
+    assert not bool(info.viol_commit)
+
+
+def test_ring_append_clamped_at_capacity():
+    """Entries past base + CAP would evict live slots -> partial accept, partial
+    ack; the leader retries the rest after commit/compaction frees room."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 1, base=2, entries=hist(2, 8), commit=8)
+    s = s._replace(term=s.term.at[1].set(2))
+    ents = [(2, 91), (2, 92), (2, 93), (2, 94)]  # abs 9..12; ring holds <= 10
+    s = ae_wire(s, 0, term=2, prev_i=8, prev_t=1, commit=8, ents=ents)
+    s2, _ = step(CFG, s)
+    assert int(s2.log_len[1]) == 10  # 9 and 10 accepted, 11 and 12 clamped off
+    assert resp_match_of(s2.mailbox, 0, 1) == 10
+    cap = CFG.log_capacity
+    assert int(s2.log_val[1, 8 % cap]) == 91
+    assert int(s2.log_val[1, 9 % cap]) == 92
+    # the slots entries 11/12 would have taken still hold live entries 3 and 4
+    assert int(s2.log_val[1, 10 % cap]) == 1003
+    assert int(s2.log_val[1, 11 % cap]) == 1004
+
+
+def test_append_below_base_skips_compacted_prefix():
+    """prev below the receiver's base is consistent by leader completeness; the
+    shipped entries overlapping the compacted prefix are skipped, the rest land."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 1, base=6, entries=hist(6, 8), commit=8)
+    s = s._replace(term=s.term.at[1].set(2))
+    # prev = 4 < base = 6; entries abs 5..8. 5 and 6 are compacted (skipped); 7
+    # and 8 match the stored terms/values -> nothing changes but the ack covers 8.
+    ents = [(1, 1005), (1, 1006), (1, 1007), (1, 1008)]
+    s = ae_wire(s, 0, term=2, prev_i=4, prev_t=1, commit=8, ents=ents)
+    before = np.asarray(s.log_val[1]).copy()
+    s2, info = step(CFG, s)
+    assert resp_ok_of(s2.mailbox, 0, 1)
+    assert resp_match_of(s2.mailbox, 0, 1) == 8
+    assert int(s2.log_len[1]) == 8
+    np.testing.assert_array_equal(np.asarray(s2.log_val[1]), before)
+    assert not bool(info.viol_commit)
+
+
+# ------------------------------------------------------- compaction + client path
+
+
+def test_compaction_advances_base_to_commit_bound():
+    """A full ring with a committed prefix rebases: base -> min(commit,
+    len - (CAP - margin)), base_term/base_chk follow."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 1, base=0, entries=hist(0, 8), commit=8)
+    s2, info = step(CFG, s)
+    # target = min(8, 8 - (8 - 2)) = 2
+    assert int(s2.log_base[1]) == 2
+    assert int(s2.base_term[1]) == 1
+    assert int(np.uint32(s2.base_chk[1])) == hist_chk(2)
+    assert int(s2.log_len[1]) == 8
+    assert not bool(info.viol_commit)
+
+
+def test_compaction_never_passes_commit():
+    """Uncommitted entries are never compacted: a full ring with a short committed
+    prefix only rebases up to commit (and the log then stays full)."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 1, base=0, entries=hist(0, 8), commit=1)
+    s2, info = step(CFG, s)
+    assert int(s2.log_base[1]) == 1
+    assert not bool(info.viol_commit)
+
+
+def test_injection_wraps_into_freed_slots():
+    """A leader whose ring wrapped keeps accepting commands: the new entry lands
+    at slot len mod CAP (previously occupied by a compacted entry)."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 0, base=4, entries=hist(4, 10), commit=10)
+    s = leader(s, 0, term=1)
+    inp = quiet_inputs(CFG)._replace(client_cmd=jnp.int32(777))
+    s2, info = step(CFG, s, inp)
+    assert int(s2.log_len[0]) == 11
+    assert int(s2.log_val[0, 10 % CFG.log_capacity]) == 777
+    assert int(info.cmds_injected) == 1
+    assert not bool(info.viol_commit)
+
+
+def test_client_injection_respects_noop_reserve():
+    """Client commands stop max(1, margin // 2) slots short of the ring so an
+    election no-op always finds room (code-review finding: a full ring of
+    old-term entries deadlocks commit forever under spec 5.4.2)."""
+    s = base_state(CFG)
+    # retained = 7 = CAP - reserve (reserve = 1 for margin 2): client blocked.
+    s = with_ring_log(s, 0, base=4, entries=hist(4, 11), commit=4)
+    s = leader(s, 0, term=1)
+    inp = quiet_inputs(CFG)._replace(client_cmd=jnp.int32(777))
+    s2, info = step(CFG, s, inp)
+    assert int(s2.log_len[0]) == 11  # rejected
+    assert int(info.cmds_injected) == 0
+
+
+def test_election_win_appends_noop_entry():
+    """A fresh leader appends a current-term NO-OP so old-term entries can pass
+    the spec-5.4.2 commit gate (otherwise a leader whose whole ring is old-term
+    entries could never advance commit -- the reviewed deadlock)."""
+    from raft_sim_tpu.types import NOOP, RESP_VOTE
+    from tests.test_handlers import resp_wire
+
+    s = base_state(CFG)
+    s = with_ring_log(s, 0, base=4, entries=hist(4, 10), commit=4)
+    s = s._replace(
+        role=s.role.at[0].set(1),  # CANDIDATE
+        term=s.term.at[0].set(5),
+        voted_for=s.voted_for.at[0].set(0),
+        votes=s.votes.at[0, 0].set(True),
+    )
+    s = resp_wire(s, 0, 1, RESP_VOTE, term=5, ok=True)
+    s = resp_wire(s, 0, 2, RESP_VOTE, term=5, ok=True)
+    s2, info = step(CFG, s)
+    assert int(s2.role[0]) == LEADER
+    assert int(s2.log_len[0]) == 11  # the no-op
+    slot = 10 % CFG.log_capacity
+    assert int(s2.log_term[0, slot]) == 5
+    assert int(s2.log_val[0, slot]) == NOOP
+    assert int(info.cmds_injected) == 0  # no-ops are not client commands
+
+
+def test_same_tick_rebase_and_injection_keeps_checksums_exact():
+    """Code-review finding (confirmed by repro): when commit jumps on a full ring,
+    compaction frees slots and the same tick's injection reuses one; the checksum
+    pass must read the OLD entry under its weight (it runs before phase 6), or
+    base_chk silently absorbs the new value under the compacted entry's weight."""
+    cap = CFG.log_capacity
+    ents = [(3, 200 + i) for i in range(13, 21)]  # abs 13..20, leader's term
+    s = base_state(CFG)
+    s = with_ring_log(s, 0, base=12, entries=ents, commit=12)  # retained == CAP
+    s = leader(s, 0, term=3)
+    # quorum already replicated everything: commit jumps 12 -> 20 this tick
+    s = s._replace(
+        match_index=s.match_index.at[0, 1].set(20).at[0, 2].set(20),
+    )
+    inp = quiet_inputs(CFG)._replace(client_cmd=jnp.int32(55))
+    s2, info = step(CFG, s, inp)
+    assert int(s2.commit_index[0]) == 20
+    # compaction target: min(20, 20 - (CAP - margin)) = 14
+    assert int(s2.log_base[0]) == 14
+    assert int(s2.log_len[0]) == 21  # injection went through
+    assert int(s2.log_val[0, 20 % cap]) == 55  # ... into just-freed slot 4
+    # checksums reflect the ORIGINAL entries 13..14 / 13..20, not the overwrite
+    want_base = (hist_chk(12) + chk_of(ents[:2], start0=12)) & M32
+    want_commit = (hist_chk(12) + chk_of(ents, start0=12)) & M32
+    assert int(np.uint32(s2.base_chk[0])) == want_base
+    assert int(np.uint32(s2.commit_chk[0])) == want_commit
+    assert not bool(info.viol_commit)
+    # and the next tick's carried-checksum verification still passes
+    _, info2 = step(CFG, s2)
+    assert not bool(info2.viol_commit)
+
+
+def test_leader_sends_snapshot_sentinel_below_base():
+    """A peer whose next_index fell below the leader's base gets req_off = -1 and
+    the snapshot header; peers inside the retained window get normal offsets."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 0, base=6, entries=hist(6, 10), commit=10)
+    s = leader(s, 0, term=1)
+    # peer 1 lags below the base; peers 2..4 are caught up
+    s = s._replace(
+        next_index=s.next_index.at[0, 1].set(3),
+        deadline=s.deadline.at[0].set(0),  # heartbeat fires this tick
+    )
+    s2, _ = step(CFG, s)
+    mb = s2.mailbox
+    assert int(mb.req_type[0]) == REQ_APPEND
+    assert int(mb.req_off[0, 1]) == -1
+    assert int(mb.req_base[0]) == 6
+    assert int(mb.req_base_term[0]) == 1
+    assert int(np.uint32(mb.req_base_chk[0])) == hist_chk(6)
+    for p in range(2, 5):
+        assert int(mb.req_off[0, p]) >= 0
+
+
+def test_restart_resumes_commit_at_base():
+    """The snapshot triple is persistent: a restarted node comes back with
+    commit = log_base and commit_chk = base_chk, not zero."""
+    s = base_state(CFG)
+    s = with_ring_log(s, 1, base=5, entries=hist(5, 9), commit=9)
+    n = CFG.n_nodes
+    inp = quiet_inputs(CFG)._replace(
+        restarted=jnp.zeros((n,), bool).at[1].set(True)
+    )
+    s2, info = step(CFG, s, inp)
+    assert int(s2.role[1]) == FOLLOWER
+    assert int(s2.log_base[1]) == 5
+    assert int(s2.commit_index[1]) == 5
+    assert int(np.uint32(s2.commit_chk[1])) == hist_chk(5)
+    assert int(s2.log_len[1]) == 9  # the log itself is persistent
+    assert not bool(info.viol_commit)
+
+
+# ----------------------------------------------------- unbounded-horizon liveness
+
+
+def test_unbounded_horizon_commands_survive_ring_exhaustion():
+    """The capability the fixed log lacks (pinned by test_handlers.
+    test_client_command_rejected_when_log_full): with compaction, a client
+    workload many times the physical capacity keeps being accepted and committed,
+    under crash + drop faults, with zero invariant violations."""
+    cfg = RaftConfig(
+        n_nodes=5,
+        log_capacity=16,
+        compact_margin=8,
+        max_entries_per_rpc=4,
+        client_interval=2,
+        drop_prob=0.1,
+        crash_prob=0.3,
+        crash_period=32,
+        crash_down_ticks=8,
+    )
+    ticks = 3000
+    _, m = scan.simulate(cfg, 0, 8, ticks)
+    m = jax.device_get(m)
+    assert int(np.sum(m.violations)) == 0
+    # every cluster committed far beyond the ring's physical capacity
+    assert int(np.min(m.max_commit)) > 20 * cfg.log_capacity
+    # and commands kept being accepted throughout (1500 offered per cluster)
+    assert int(np.min(m.total_cmds)) > 1000

@@ -349,11 +349,17 @@ def test_append_response_success_updates_indices():
 
 
 def test_append_response_failure_decrements_next_index():
+    """A nack's match field carries the responder's log length (the conflict-index
+    hint, PARITY.md "protocol additions"): next = max(min(next-1, hint+1), 1) --
+    an adjacent conflict still steps back one, a far-behind follower is reached in
+    one round trip instead of one slot per heartbeat."""
     s = with_log(base_state(), 0, [1, 1, 1])
     s = make_leader(s, 0, 1)
-    s = resp_wire(s, 0, 1, RESP_APPEND, term=1, ok=False)
+    s = resp_wire(s, 0, 1, RESP_APPEND, term=1, ok=False, match=3)  # hint: len 3
+    s = resp_wire(s, 0, 2, RESP_APPEND, term=1, ok=False, match=0)  # hint: empty log
     s2, _ = step(CFG, s)
-    assert int(s2.next_index[0, 1]) == 3  # 4 - 1
+    assert int(s2.next_index[0, 1]) == 3  # min(4-1, 3+1): plain decrement
+    assert int(s2.next_index[0, 2]) == 1  # min(4-1, 0+1): jump straight to 1
 
 
 def test_leader_steps_down_on_higher_term_response():

@@ -83,6 +83,28 @@ CONFIGS = [
         # the shared-window start (the no-responsive fallback needs a deterministic
         # scenario: test_handlers.test_window_fallback_when_no_peer_responsive)
     ),
+    pytest.param(
+        RaftConfig(n_nodes=3, log_capacity=8, compact_margin=4, client_interval=1),
+        6,
+        id="n3-compaction",  # 150 commands through an 8-slot ring: continuous
+        # rebase + wrapped appends, absolute indices far past CAP
+    ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            max_entries_per_rpc=2,
+            client_interval=1,
+            drop_prob=0.2,
+            crash_prob=0.5,
+            crash_period=20,
+            crash_down_ticks=12,
+        ),
+        7,
+        id="n5-compaction-snap",  # crashed nodes fall below the leader's base and
+        # catch up via the InstallSnapshot sentinel (keep AND wipe paths)
+    ),
 ]
 
 
