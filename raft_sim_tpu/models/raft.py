@@ -557,7 +557,6 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # responder's term rides per responder (same value toward every requester).
     out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
     out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match, wide=comp)
-    z32 = jnp.zeros((n,), jnp.int32)
     pterm = (
         log_ops.term_at_r(log_term_arr, base, bterm, ws)
         if comp
@@ -575,12 +574,12 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
         ent_val=out_ent_val,
-        req_base=jnp.where(send_append, base, 0) if comp else z32,
-        req_base_term=jnp.where(send_append, bterm, 0) if comp else z32,
+        # Without compaction the snapshot header is dead weight: pass the zeros
+        # through untouched so XLA sees a loop-invariant carry component.
+        req_base=jnp.where(send_append, base, 0) if comp else mb.req_base,
+        req_base_term=jnp.where(send_append, bterm, 0) if comp else mb.req_base_term,
         req_base_chk=(
-            jnp.where(send_append, bchk, jnp.uint32(0))
-            if comp
-            else jnp.zeros((n,), jnp.uint32)
+            jnp.where(send_append, bchk, jnp.uint32(0)) if comp else mb.req_base_chk
         ),
         req_off=out_req_off,
         resp_word=out_resp_word,

@@ -359,10 +359,13 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         bterm = log_ops.term_at_rb(log_term_arr, base, bterm, base2)  # = bterm if unchanged
         base = base2
 
-    # ---- committed-prefix checksum (raft.py: anchored at base_mid, MUST run
-    # before phase 6 -- an injection into a slot freed by this tick's rebase would
-    # alias under the anchored slot->index map; maintained even with invariant
-    # checking off under compaction, since base_chk is load-bearing wire state) ----
+    # ---- committed-prefix checksum, compaction form (raft.py: anchored at
+    # base_mid, MUST run before phase 6 -- an injection into a slot freed by this
+    # tick's rebase would alias under the anchored slot->index map; maintained
+    # even with invariant checking off, since base_chk is load-bearing wire
+    # state). The non-compaction form has no aliasing hazard and stays at its
+    # original post-outbox position (placement affects XLA fusion of the hot
+    # configs).
     if comp:
         co = jnp.maximum(s.commit_index, base_mid)  # snap installs skip the check
         s_co, s_bf, s_cn = log_ops.ring_chk_b(
@@ -374,14 +377,6 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             chk_ok = jnp.ones_like(s.commit_index, dtype=bool)
         bchk = bchk_mid + s_bf
         chk_new = bchk_mid + s_cn
-    elif cfg.check_invariants:
-        chk_old, chk_new = log_ops.prefix_chk2_b(
-            log_term_arr, log_val_arr, s.commit_index, commit
-        )
-        chk_ok = chk_old == s.commit_chk
-    else:
-        chk_new = s.commit_chk
-        chk_ok = jnp.ones_like(s.commit_index, dtype=bool)
 
     # ---- phase 6: client command injection (+ election-win no-op under
     # compaction; raft.py phase 6) --------------------------------------------------
@@ -479,7 +474,6 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         pterm = log_ops.term_at_rb(log_term_arr, base, bterm, ws)
     else:
         pterm = log_ops.term_at_b(log_term_arr, ws)
-    zb = jnp.zeros_like(s.commit_index)
 
     new_mb = Mailbox(
         req_type=out_req_type,
@@ -492,17 +486,28 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
         ent_val=out_ent_val,
-        req_base=jnp.where(send_append, base, 0) if comp else zb,
-        req_base_term=jnp.where(send_append, bterm, 0) if comp else zb,
+        # Without compaction the snapshot header is dead weight: pass the zeros
+        # through untouched so XLA sees a loop-invariant carry component (raft.py).
+        req_base=jnp.where(send_append, base, 0) if comp else mb.req_base,
+        req_base_term=jnp.where(send_append, bterm, 0) if comp else mb.req_base_term,
         req_base_chk=(
-            jnp.where(send_append, bchk, jnp.uint32(0))
-            if comp
-            else jnp.zeros_like(s.base_chk)
+            jnp.where(send_append, bchk, jnp.uint32(0)) if comp else mb.req_base_chk
         ),
         req_off=out_req_off,
         resp_word=out_resp_word,
         resp_term=term,
     )
+
+    # Committed-prefix checksum, non-compaction form (log_ops module comment).
+    if not comp:
+        if cfg.check_invariants:
+            chk_old, chk_new = log_ops.prefix_chk2_b(
+                log_term_arr, log_val_arr, s.commit_index, commit
+            )
+            chk_ok = chk_old == s.commit_chk
+        else:
+            chk_new = s.commit_chk
+            chk_ok = jnp.ones_like(s.commit_index, dtype=bool)
 
     new_state = ClusterState(
         role=role,

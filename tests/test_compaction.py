@@ -393,6 +393,52 @@ def test_restart_resumes_commit_at_base():
     assert not bool(info.viol_commit)
 
 
+# ------------------------------------------- completeness across compaction
+
+
+def test_committed_sequence_across_compaction_boundaries():
+    """The end-to-end data audit (tests/test_completeness.py) extended past the
+    ring: committed values vanish from the final arrays once compacted, so the
+    audit reads each entry AT THE TICK IT COMMITS from a traced run -- newly
+    committed entries are always still live then (nothing overwrites a slot
+    within CAP of the commit frontier). Every committed index must carry one
+    stable value on every node, and the client values must be exactly a
+    prefix-ordered subsequence of the offered schedule, NOOPs interleaved."""
+    import jax.numpy as jnp
+
+    from raft_sim_tpu.types import NOOP
+
+    cfg = RaftConfig(n_nodes=3, log_capacity=8, compact_margin=4, client_interval=2)
+    cap, ticks = cfg.log_capacity, 400
+    key = jax.random.key(1)
+    k_init, k_run = jax.random.split(key)
+    state = init_state(cfg, k_init)
+    _, _, (infos, states) = jax.jit(
+        lambda s, k: scan.run(cfg, s, k, ticks, trace_states=True)
+    )(state, k_run)
+    commit = np.asarray(states.commit_index)  # [T, N]
+    lv = np.asarray(states.log_val)  # [T, N, CAP]
+
+    vals: dict[int, int] = {}  # absolute 1-based index -> committed value
+    n = cfg.n_nodes
+    for t in range(ticks):
+        for i in range(n):
+            c0 = int(commit[t - 1, i]) if t else 0
+            for k in range(c0 + 1, int(commit[t, i]) + 1):
+                v = int(lv[t, i, (k - 1) % cap])
+                assert vals.setdefault(k, v) == v, f"index {k} committed twice with different values"
+
+    maxc = int(commit[-1].max())
+    assert maxc > 10 * cap  # the audit really crossed many compaction boundaries
+    assert set(vals) >= set(range(1, maxc + 1))  # no committed index unobserved
+
+    seq = [vals[k] for k in range(1, maxc + 1)]
+    client_vals = [v for v in seq if v != NOOP]
+    offers = {t + 1 for t in range(0, ticks, cfg.client_interval)}
+    assert set(client_vals) <= offers  # nothing committed that was never offered
+    assert client_vals == sorted(client_vals)  # offer order preserved
+
+
 # ----------------------------------------------------- unbounded-horizon liveness
 
 
