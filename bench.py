@@ -70,6 +70,7 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2) -> dict:
         "wall_s": round(best, 3),
         "p50_stable_tick": s.p50_stable_tick,
         "pct_stable": round(100.0 * s.n_stable / s.n_clusters, 1),
+        "p50_commit_latency": s.p50_commit_latency,
         "violations": s.total_violations,
     }
 

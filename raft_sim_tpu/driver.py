@@ -124,14 +124,16 @@ class Session:
         )
         self.metrics = chunked.merge_metrics(self.metrics, m)
 
-    def offer(self, value: int) -> dict:
-        """Offer one client command to every cluster's current leader and advance one
-        tick -- the reference's ad-hoc `curl POST /client-set` (server.clj:8-12,
-        core.clj:151-160), minus the redirect dance (membership is globally visible
-        here; see models/raft.py phase 6). Overrides that tick's scheduled client
-        input, metrics accumulate as in run(). Returns {"accepted": count} --
-        clusters whose live leader appended the value (no leader -> not accepted,
-        unlike the reference's never-firing commit watch, bug 2.3.9).
+    def offer(self, value: int, wait: int = 0) -> dict:
+        """Offer one client command and advance one tick -- the reference's ad-hoc
+        `curl POST /client-set` (server.clj:8-12, core.clj:151-160; with
+        cfg.client_redirect the kernel routes it through the 302 redirect dance).
+        Overrides that tick's scheduled client input, metrics accumulate as in
+        run(). Returns {"accepted", "committed", "waited"}: `accepted` counts
+        clusters whose live leader appended the value; `committed` counts clusters
+        where the value has COMMITTED after up to `wait` further ticks -- the ack
+        the reference's commit watch was meant to deliver and never did
+        (log.clj:83-87, bug 2.3.9).
         """
         value = int(value)
         from raft_sim_tpu.types import NIL, NOOP
@@ -145,7 +147,26 @@ class Session:
         self.state, self.metrics, accepted = _offer_tick(
             self.cfg, self.state, self.keys, self.metrics, value
         )
-        return {"accepted": int(np.sum(np.asarray(accepted)))}
+        accepted = int(np.sum(np.asarray(accepted)))
+        committed, waited = self._count_committed(value), 0
+        while waited < wait and committed < accepted:
+            self.run(1, chunk=1)
+            waited += 1
+            committed = self._count_committed(value)
+        return {"accepted": accepted, "committed": committed, "waited": waited}
+
+    def _count_committed(self, value: int) -> int:
+        """Clusters in which `value` is a committed live entry (host-side scan of
+        the ring; entries compacted past the base are no longer attributable)."""
+        st = jax.device_get(self.state)
+        lv = np.asarray(st.log_val)  # [B, N, CAP]
+        commit = np.asarray(st.commit_index)[:, :, None]
+        base = np.asarray(st.log_base)[:, :, None]
+        cap = self.cfg.log_capacity
+        sl = np.arange(cap)[None, None, :]
+        abs1 = base + (sl - base) % cap + 1  # absolute 1-based index per slot
+        hit = (lv == value) & (abs1 > base) & (abs1 <= commit)
+        return int(np.any(hit, axis=(1, 2)).sum())
 
     def trace(self, n_ticks: int, cluster: int = 0):
         """Step a single selected cluster with full per-tick info + states captured

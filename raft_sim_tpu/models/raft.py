@@ -391,6 +391,24 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         commit,
     )
 
+    # ---- offer->commit latency (client workloads only) ---------------------------
+    # Each client entry's value encodes its offer tick (faults.make_inputs), so
+    # the live leader's commit advancement this tick contributes
+    # (now - offer_tick) per newly committed client entry -- the measurement the
+    # reference's commit watch was meant to feed (log.clj:83-87, never fired, bug
+    # 2.3.9). Read before compaction/injection can touch slots (same aliasing
+    # rule as the checksum pass).
+    if cfg.client_interval > 0:
+        sl = jnp.arange(cap, dtype=jnp.int32)[None, :]
+        abs1 = (base[:, None] + (sl - base[:, None]) % cap + 1) if comp else (sl + 1)
+        newly = (abs1 > s.commit_index[:, None]) & (abs1 <= commit[:, None])
+        lm = (is_leader & inp.alive)[:, None] & newly & (log_val_arr != NOOP)
+        lat_sum = jnp.sum(jnp.where(lm, s.now - log_val_arr + 1, 0)).astype(jnp.int32)
+        lat_cnt = jnp.sum(lm).astype(jnp.int32)
+    else:
+        lat_sum = jnp.int32(0)
+        lat_cnt = jnp.int32(0)
+
     # ---- phase 5.5: log compaction -------------------------------------------------
     # The reference's unbounded log vector (log.clj:33) needs none; the ring must
     # free committed slots or a long-horizon client workload would exhaust it
@@ -438,26 +456,51 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         chk_ok = jnp.ones((n,), bool)
 
     # ---- phase 6: client command injection (client-set-handler core.clj:151-160) --
-    # The simulator's "client" writes straight to the leader; the reference's
-    # redirect-to-leader dance (core.clj:152-155) has no array equivalent because
-    # cluster membership is globally visible here. Under compaction, a fresh
+    # Routing: with client_redirect the client POSTs one node and chases 302
+    # redirects at one tick per bounce (the reference's write path,
+    # core.clj:151-160, server.clj:62-63); otherwise the omniscient simulator
+    # client writes straight to every live leader. Under compaction, a fresh
     # election win appends a leader NO-OP entry instead (spec 5.4.2 workaround:
     # old-term entries only commit via a current-term entry at quorum, and a full
     # ring of old-term entries would otherwise deadlock commit forever -- see
     # docs/DESIGN.md); client injections keep `noop_reserve` slots free so a
     # no-op slot survives commit-free election chains up to that depth.
-    client_ok = (inp.client_cmd != NIL) & is_leader & inp.alive
     if comp:
         reserve = max(1, cfg.compact_margin // 2)
         noop = win & (log_len - base < cap)
-        client_ok = client_ok & ~noop & (log_len - base < cap - reserve)
-        do_write = noop | client_ok
-        wval = jnp.where(noop, NOOP, inp.client_cmd)
+        room = log_len - base < cap - reserve
     else:
-        client_ok = client_ok & (log_len - base < cap)
-        do_write = client_ok
-        wval = jnp.broadcast_to(inp.client_cmd, (n,))
+        noop = jnp.zeros((n,), bool)
+        room = log_len - base < cap
+    if cfg.client_redirect:
+        # One command in flight: the pending redirected command, else a fresh
+        # offer (dropped while the client is busy).
+        have_pend = s.client_pend != NIL
+        fresh = (inp.client_cmd != NIL) & ~have_pend
+        cmd = jnp.where(have_pend, s.client_pend, inp.client_cmd)
+        tgt = jnp.where(have_pend, s.client_dst, inp.client_target)
+        active = have_pend | fresh
+        tgt_oh = ids == tgt
+        client_ok = active & tgt_oh & is_leader & inp.alive & room & ~noop
+        accepted = jnp.any(client_ok)
+        # Redirect the client: to the target's known leader when the target is up
+        # and knows one, else to a random peer (core.clj:152-155). A rejected
+        # POST at a full leader retries there next tick.
+        tgt_ld = jnp.max(jnp.where(tgt_oh, leader_id, NIL))
+        tgt_up = jnp.any(tgt_oh & inp.alive)
+        pend_on = active & ~accepted
+        client_pend = jnp.where(pend_on, cmd, NIL)
+        client_dst = jnp.where(
+            pend_on, jnp.where(tgt_up & (tgt_ld != NIL), tgt_ld, inp.client_bounce), 0
+        )
+    else:
+        client_ok = (inp.client_cmd != NIL) & is_leader & inp.alive & room & ~noop
+        cmd = inp.client_cmd
+        client_pend = s.client_pend
+        client_dst = s.client_dst
+    do_write = noop | client_ok
     do_inject = client_ok  # metrics count client accepts only, not leader no-ops
+    wval = jnp.where(noop, NOOP, cmd)
     inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)
     log_term_arr = log_term_arr.at[ids, inj_pos].set(term, mode="drop")
     log_val_arr = log_val_arr.at[ids, inj_pos].set(
@@ -605,11 +648,16 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         log_len=log_len,
         clock=clock,
         deadline=deadline,
+        client_pend=client_pend,
+        client_dst=client_dst,
         now=s.now + 1,
         mailbox=new_mb,
     )
 
-    info = _step_info(cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok)
+    info = _step_info(
+        cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok,
+        lat_sum, lat_cnt,
+    )
     return new_state, info
 
 
@@ -622,6 +670,8 @@ def _step_info(
     alive: jax.Array,
     do_inject: jax.Array,
     chk_ok: jax.Array,
+    lat_sum: jax.Array,
+    lat_cnt: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
     n = cfg.n_nodes
@@ -723,4 +773,6 @@ def _step_info(
         # accept the same offered command; that is ONE offer accepted, and the
         # offered-vs-committed audit (tests/test_completeness.py) counts offers.
         cmds_injected=jnp.any(do_inject).astype(jnp.int32),
+        lat_sum=lat_sum,
+        lat_cnt=lat_cnt,
     )

@@ -351,6 +351,23 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         commit,
     )
 
+    # ---- offer->commit latency (client workloads only; raft.py) ------------------
+    if cfg.client_interval > 0:
+        sl = iota((1, cap, 1), 1)
+        if comp:
+            abs1 = base[:, None, :] + (sl - base[:, None, :]) % cap + 1
+        else:
+            abs1 = sl + 1
+        newly = (abs1 > s.commit_index[:, None, :]) & (abs1 <= commit[:, None, :])
+        lm = (is_leader & inp.alive)[:, None, :] & newly & (log_val_arr != NOOP)
+        lat_sum = jnp.sum(
+            jnp.where(lm, s.now[None, None, :] - log_val_arr + 1, 0), axis=(0, 1)
+        ).astype(jnp.int32)
+        lat_cnt = jnp.sum(lm, axis=(0, 1)).astype(jnp.int32)
+    else:
+        lat_sum = jnp.zeros_like(s.now)
+        lat_cnt = jnp.zeros_like(s.now)
+
     # ---- phase 5.5: log compaction (raft.py) -------------------------------------
     base_mid, bchk_mid = base, bchk  # post-install, pre-advance (checksum anchor)
     if comp:
@@ -378,20 +395,39 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         bchk = bchk_mid + s_bf
         chk_new = bchk_mid + s_cn
 
-    # ---- phase 6: client command injection (+ election-win no-op under
-    # compaction; raft.py phase 6) --------------------------------------------------
-    client_ok = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive
+    # ---- phase 6: client command injection, redirect routing, election-win
+    # no-op (raft.py phase 6) --------------------------------------------------------
     if comp:
         reserve = max(1, cfg.compact_margin // 2)
         noop = win & (log_len - base < cap)
-        client_ok = client_ok & ~noop & (log_len - base < cap - reserve)
-        do_write = noop | client_ok
-        wval = jnp.where(noop, NOOP, inp.client_cmd[None, :])
+        room = log_len - base < cap - reserve
     else:
-        client_ok = client_ok & (log_len - base < cap)
-        do_write = client_ok
-        wval = jnp.broadcast_to(inp.client_cmd[None, :], log_len.shape)
+        noop = jnp.zeros_like(is_leader)
+        room = log_len - base < cap
+    if cfg.client_redirect:
+        have_pend = s.client_pend != NIL  # [B]
+        fresh = (inp.client_cmd != NIL) & ~have_pend
+        cmd = jnp.where(have_pend, s.client_pend, inp.client_cmd)  # [B]
+        tgt = jnp.where(have_pend, s.client_dst, inp.client_target)
+        active = have_pend | fresh
+        tgt_oh = iota((n, 1), 0) == tgt[None, :]  # [N, B]
+        client_ok = active[None, :] & tgt_oh & is_leader & inp.alive & room & ~noop
+        accepted = jnp.any(client_ok, axis=0)  # [B]
+        tgt_ld = jnp.max(jnp.where(tgt_oh, leader_id, NIL), axis=0)  # [B]
+        tgt_up = jnp.any(tgt_oh & inp.alive, axis=0)
+        pend_on = active & ~accepted
+        client_pend = jnp.where(pend_on, cmd, NIL)
+        client_dst = jnp.where(
+            pend_on, jnp.where(tgt_up & (tgt_ld != NIL), tgt_ld, inp.client_bounce), 0
+        )
+    else:
+        client_ok = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive & room & ~noop
+        cmd = inp.client_cmd
+        client_pend = s.client_pend
+        client_dst = s.client_dst
+    do_write = noop | client_ok
     do_inject = client_ok  # metrics count client accepts only, not leader no-ops
+    wval = jnp.where(noop, NOOP, cmd[None, :])  # [N, B]
     # cap matches no slot -> masked-off writes dropped.
     inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)  # [N, B]
     inj_oh = iota((1, cap, 1), 1) == inj_pos[:, None, :]  # [N, CAP, B]
@@ -528,11 +564,16 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         log_len=log_len,
         clock=clock,
         deadline=deadline,
+        client_pend=client_pend,
+        client_dst=client_dst,
         now=s.now + 1,
         mailbox=new_mb,
     )
 
-    info = _step_info_b(cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok)
+    info = _step_info_b(
+        cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok,
+        lat_sum, lat_cnt,
+    )
     return new_state, info
 
 
@@ -545,6 +586,8 @@ def _step_info_b(
     alive: jax.Array,
     do_inject: jax.Array,
     chk_ok: jax.Array,
+    lat_sum: jax.Array,
+    lat_cnt: jax.Array,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
@@ -639,4 +682,6 @@ def _step_info_b(
             jnp.sum(req_in, axis=(0, 1)) + jnp.sum(resp_in, axis=(0, 1))
         ).astype(jnp.int32),
         cmds_injected=jnp.any(do_inject, axis=0).astype(jnp.int32),  # offers, not leaders; see raft.py
+        lat_sum=lat_sum,
+        lat_cnt=lat_cnt,
     )

@@ -125,6 +125,10 @@ class FleetSummary(NamedTuple):
     max_term: int
     total_msgs: int
     total_cmds: int  # client commands accepted fleet-wide (offered vs committed audit)
+    # Fleet p50 of per-cluster MEAN offer->commit latency (ticks), measured at
+    # each live leader's commit advancement; None when no cluster committed any
+    # client entry (e.g. client_interval == 0).
+    p50_commit_latency: float | None
 
 
 def summarize(metrics) -> FleetSummary:
@@ -137,6 +141,12 @@ def summarize(metrics) -> FleetSummary:
     # None (JSON null) rather than inf: json.dumps(inf) emits non-standard `Infinity`.
     p50 = float(np.median(reached)) if reached.size else None
     m = jax.device_get(metrics)
+    committed = m.lat_cnt > 0
+    p50_lat = (
+        float(np.median(m.lat_sum[committed] / m.lat_cnt[committed]))
+        if np.any(committed)
+        else None
+    )
     return FleetSummary(
         n_clusters=int(m.ticks.shape[0]),
         total_violations=int(np.sum(m.violations)),
@@ -145,4 +155,5 @@ def summarize(metrics) -> FleetSummary:
         max_term=int(np.max(m.max_term)),
         total_msgs=int(np.sum(m.total_msgs, dtype=np.int64)),
         total_cmds=int(np.sum(m.total_cmds, dtype=np.int64)),
+        p50_commit_latency=p50_lat,
     )

@@ -35,6 +35,8 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
         min_commit=b.min_commit,  # "at final tick" -> later segment wins
         total_msgs=a.total_msgs + b.total_msgs,
         total_cmds=a.total_cmds + b.total_cmds,
+        lat_sum=a.lat_sum + b.lat_sum,
+        lat_cnt=a.lat_cnt + b.lat_cnt,
         ticks=a.ticks + b.ticks,
     )
 

@@ -111,13 +111,24 @@ def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
     # Election-timeout draws (one per node per tick, used on any timer reset).
     timeout_draw = draw_timeouts(cfg, k_timeout, n)
 
-    # Client commands: value = tick at injection (payload bytes carry no protocol
-    # meaning in the reference either, log.clj:66-67).
+    # Client commands: value = tick at injection + 1 (payload bytes carry no
+    # protocol meaning in the reference either, log.clj:66-67; the +1 keeps 0 free
+    # and lets the commit-latency metric recover the offer tick from the value).
     if cfg.client_interval > 0:
         client_cmd = jnp.where(now % cfg.client_interval == 0, now + 1, NIL)
     else:
         client_cmd = jnp.int32(NIL)
     client_cmd = jnp.asarray(client_cmd, jnp.int32)
+
+    # Client routing draws (redirect model only): the random node a fresh offer
+    # POSTs to, and the random peer a leaderless redirect bounces to.
+    if cfg.client_redirect:
+        k_tgt, k_bnc = jax.random.split(jax.random.fold_in(tkey, 3))
+        client_target = jax.random.randint(k_tgt, (), 0, n)
+        client_bounce = jax.random.randint(k_bnc, (), 0, n)
+    else:
+        client_target = jnp.int32(0)
+        client_bounce = jnp.int32(0)
 
     # Crash/restart schedule (restart edge = alive now, down last tick).
     if cfg.crash_prob > 0:
@@ -133,6 +144,8 @@ def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
         skew=skew,
         timeout_draw=timeout_draw,
         client_cmd=client_cmd,
+        client_target=client_target,
+        client_bounce=client_bounce,
         alive=alive,
         restarted=restarted,
     )

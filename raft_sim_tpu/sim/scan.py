@@ -50,6 +50,11 @@ class RunMetrics(NamedTuple):
     min_commit: jax.Array  # int32: min over nodes at the final tick
     total_msgs: jax.Array  # int32: delivered records over the run
     total_cmds: jax.Array  # int32: client commands accepted by a live leader
+    # Offer->commit latency accumulators (StepInfo.lat_sum/lat_cnt): this
+    # cluster's mean commit latency is lat_sum / lat_cnt; parallel.summarize
+    # rolls the fleet p50 of those means.
+    lat_sum: jax.Array  # int32
+    lat_cnt: jax.Array  # int32
     ticks: jax.Array  # int32
 
 
@@ -69,6 +74,8 @@ def init_metrics() -> RunMetrics:
         min_commit=z,
         total_msgs=z,
         total_cmds=z,
+        lat_sum=z,
+        lat_cnt=z,
         ticks=z,
     )
 
@@ -89,6 +96,8 @@ def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
         min_commit=info.min_commit,
         total_msgs=m.total_msgs + info.msgs_delivered,
         total_cmds=m.total_cmds + info.cmds_injected,
+        lat_sum=m.lat_sum + info.lat_sum,
+        lat_cnt=m.lat_cnt + info.lat_cnt,
         ticks=m.ticks + 1,
     )
 

@@ -213,6 +213,12 @@ class ClusterState(NamedTuple):
     log_len: jax.Array  # [N] int32
     clock: jax.Array  # [N] int32 local (skewable) clock
     deadline: jax.Array  # [N] int32 next timer fire on the local clock
+    # Client-side state (cfg.client_redirect; NIL/0 otherwise): the one command the
+    # simulated client has in flight and the node its next POST targets -- the
+    # array form of the reference client chasing HTTP 302 redirects
+    # (core.clj:151-160). Not node state: crash faults never touch it.
+    client_pend: jax.Array  # scalar int32 command value in flight (NIL = none)
+    client_dst: jax.Array  # scalar int32 node the pending command targets
     now: jax.Array  # scalar int32 global tick counter
     mailbox: Mailbox
 
@@ -225,7 +231,12 @@ class StepInputs(NamedTuple):
     deliver_mask: jax.Array  # [N, N] bool; False = message on edge [dst, src] dropped
     skew: jax.Array  # [N] int32 local-clock increment this tick (normally 1)
     timeout_draw: jax.Array  # [N] int32 election timeout to use on any timer reset
-    client_cmd: jax.Array  # scalar int32 command value offered to the leader; NIL = none
+    client_cmd: jax.Array  # scalar int32 command value offered this tick; NIL = none
+    # Client routing draws (cfg.client_redirect; zeros otherwise): the node a
+    # fresh offer targets, and the random peer a leaderless redirect bounces to
+    # (core.clj:154).
+    client_target: jax.Array  # scalar int32 in [0, N)
+    client_bounce: jax.Array  # scalar int32 in [0, N)
     alive: jax.Array  # [N] bool; False = node crashed this tick (silent, frozen)
     restarted: jax.Array  # [N] bool; True = node came back up this tick (volatile wipe)
 
@@ -244,6 +255,13 @@ class StepInfo(NamedTuple):
     min_commit: jax.Array  # int32
     msgs_delivered: jax.Array  # int32: request+response records delivered this tick
     cmds_injected: jax.Array  # int32 0/1: an offered command was accepted by a live leader
+    # Offer->commit latency, measured at the live leader's commit advancement
+    # (the ack point the reference's never-firing commit watch was meant to be,
+    # log.clj:83-87): entries carry their offer tick in their value, so newly
+    # committed client entries contribute (now - offer_tick) each. Zeros unless
+    # cfg.client_interval > 0.
+    lat_sum: jax.Array  # int32: sum of commit latencies of entries committed this tick
+    lat_cnt: jax.Array  # int32: number of client entries committed this tick
 
 
 def empty_mailbox(cfg: RaftConfig) -> Mailbox:
@@ -295,6 +313,8 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         log_len=jnp.zeros((n,), jnp.int32),
         clock=jnp.zeros((n,), jnp.int32),
         deadline=deadline,
+        client_pend=jnp.int32(NIL),
+        client_dst=jnp.int32(0),
         now=jnp.int32(0),
         mailbox=empty_mailbox(cfg),
     )

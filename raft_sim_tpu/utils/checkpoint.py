@@ -40,7 +40,9 @@ from raft_sim_tpu.utils.config import RaftConfig
 # v10: ring-log compaction -- ClusterState gained log_base/base_term/base_chk,
 #      Mailbox gained the snapshot header (req_base/req_base_term/req_base_chk);
 #      compaction configs widen next/match and resp_word to int32.
-_FORMAT_VERSION = 10
+# v11: client write path -- ClusterState gained client_pend/client_dst (redirect
+#      routing state), RunMetrics gained lat_sum/lat_cnt (commit latency).
+_FORMAT_VERSION = 11
 
 
 def _normalize(path: str) -> str:

@@ -104,8 +104,18 @@ class RaftConfig:
 
     # Client command injection (reference: external curl POST /client-set,
     # server.clj:8-12, core.clj:151-160). Every `client_interval` ticks one command is
-    # offered to each cluster's current leader; 0 disables.
+    # offered to each cluster; 0 disables.
     client_interval: int = 0
+    # Client request routing. False: the omniscient client writes straight to the
+    # current live leader (the original simulator shortcut). True: the reference's
+    # real write path (core.clj:151-160, server.clj:62-63) -- each offer targets a
+    # RANDOM node; a non-leader target redirects the client to its known leader
+    # (the HTTP 302 analogue, costing one tick per bounce) or to a random peer
+    # when leaderless (core.clj:154); the client keeps one command in flight and
+    # drops new offers while busy. Offer->commit latency is tracked either way
+    # (RunMetrics.lat_sum/lat_cnt; the reference's commit watch, log.clj:83-87,
+    # never fired -- bug 2.3.9).
+    client_redirect: bool = False
 
     # On-device safety checking (north star: invariants checked every tick)
     check_invariants: bool = True

@@ -377,25 +377,57 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             base_chk[d] = np.uint32(accb)
 
     # ---- phase 6: client injection (ring slot; space = retained window < CAP),
-    # plus the election-win leader no-op under compaction (raft.py phase 6)
-    cmd = int(inp["client_cmd"])
+    # redirect routing, and the election-win leader no-op (raft.py phase 6)
+    cmd_in = int(inp["client_cmd"])
     comp = cfg.compact_margin > 0
     reserve = max(1, cfg.compact_margin // 2)
-    for d in range(n):
+    client_pend = int(s["client_pend"])
+    client_dst = int(s["client_dst"])
+
+    def noop_at(d):
+        return comp and win[d] and int(log_len[d]) - int(log_base[d]) < cap
+
+    def room_at(d):
         retained = int(log_len[d]) - int(log_base[d])
-        if comp and win[d] and retained < cap:
-            log_term[d, log_len[d] % cap] = term[d]
-            log_val[d, log_len[d] % cap] = NOOP
-            log_len[d] += 1
-        elif (
-            cmd != NIL
-            and role[d] == LEADER
-            and alive[d]
-            and retained < (cap - reserve if comp else cap)
-        ):
-            log_term[d, log_len[d] % cap] = term[d]
-            log_val[d, log_len[d] % cap] = cmd
-            log_len[d] += 1
+        return retained < (cap - reserve if comp else cap)
+
+    def append(d, value):
+        log_term[d, log_len[d] % cap] = term[d]
+        log_val[d, log_len[d] % cap] = value
+        log_len[d] += 1
+
+    if cfg.client_redirect:
+        # One command in flight, chasing 302 redirects (raft.py phase 6).
+        have = client_pend != NIL
+        fresh = cmd_in != NIL and not have
+        c = client_pend if have else cmd_in
+        t = int(client_dst) if have else int(inp["client_target"])
+        active = have or fresh
+        accepted = (
+            active
+            and role[t] == LEADER
+            and alive[t]
+            and room_at(t)
+            and not noop_at(t)
+        )
+        accept_at = {t} if accepted else set()
+        if active and not accepted:
+            tl = int(leader_id[t])
+            client_pend = c
+            client_dst = tl if (alive[t] and tl != NIL) else int(inp["client_bounce"])
+        else:
+            client_pend, client_dst = NIL, 0
+        for d in range(n):
+            if noop_at(d):
+                append(d, NOOP)
+            elif d in accept_at:
+                append(d, c)
+    else:
+        for d in range(n):
+            if noop_at(d):
+                append(d, NOOP)
+            elif cmd_in != NIL and role[d] == LEADER and alive[d] and room_at(d):
+                append(d, cmd_in)
 
     # ---- phase 7: timers
     clock = s["clock"] + np.asarray(inp["skew"], np.int32)
@@ -523,6 +555,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "log_len": log_len,
         "clock": clock,
         "deadline": deadline,
+        "client_pend": np.int32(client_pend),
+        "client_dst": np.int32(client_dst),
         "now": np.int32(int(s["now"]) + 1),
         "mailbox": out,
     }

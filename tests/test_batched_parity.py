@@ -52,6 +52,21 @@ CONFIGS = [
         id="n5-compaction-snap",  # ring wrap + rebase + InstallSnapshot sentinel,
         # wide (int32) index planes, ring-aware log-matching check
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            client_interval=2,
+            client_redirect=True,
+            drop_prob=0.2,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        id="n5-redirect-compaction",  # 302 routing state + latency metric riding
+        # the compaction ring
+    ),
 ]
 
 

@@ -1,0 +1,130 @@
+"""Client write-path fidelity: redirect routing + offer->commit latency.
+
+The reference's write path is POST any node -> HTTP 302 redirect to the known
+leader, or to a random peer when leaderless (core.clj:151-160, server.clj:62-63),
+and its commit watch was meant to ack the client on commit but never fires
+(log.clj:83-87, bug 2.3.9). Here `client_redirect=True` reproduces the routing as
+pure array state (one command in flight, one tick per bounce) and the latency the
+watch should have measured is a first-class metric
+(RunMetrics.lat_sum/lat_cnt -> FleetSummary.p50_commit_latency).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_sim_tpu import NIL, RaftConfig
+from raft_sim_tpu.parallel import summarize
+from raft_sim_tpu.sim import scan
+from tests.test_handlers import base_state, make_leader, quiet_inputs, step
+
+CFG_R = RaftConfig(n_nodes=5, log_capacity=8, client_redirect=True)
+
+
+def offer_inputs(cfg, cmd, target, bounce=0):
+    return quiet_inputs(cfg)._replace(
+        client_cmd=jnp.int32(cmd),
+        client_target=jnp.int32(target),
+        client_bounce=jnp.int32(bounce),
+    )
+
+
+def test_offer_at_leader_accepted_same_tick():
+    s = make_leader(base_state(CFG_R), 0, 2)
+    s2, info = step(CFG_R, s, offer_inputs(CFG_R, 50, target=0))
+    assert int(s2.log_len[0]) == 1
+    assert int(s2.log_val[0, 0]) == 50
+    assert int(s2.client_pend) == NIL
+    assert int(info.cmds_injected) == 1
+
+
+def test_redirect_via_follower_costs_exactly_one_tick():
+    """The VERDICT-pinned property: on a reliable net with a known leader, an
+    offer targeting a follower lands one tick after a direct offer would -- the
+    302 redirect bounce (server.clj:62-63)."""
+    s = make_leader(base_state(CFG_R), 0, 2)  # every node knows leader 0
+    s2, info = step(CFG_R, s, offer_inputs(CFG_R, 50, target=2))
+    # tick 1: the follower redirects; nothing lands anywhere
+    assert int(jnp.max(s2.log_len)) == 0
+    assert int(info.cmds_injected) == 0
+    assert int(s2.client_pend) == 50
+    assert int(s2.client_dst) == 0  # redirected to the known leader
+    # tick 2: the redirected POST lands on the leader
+    s3, info2 = step(CFG_R, s2, quiet_inputs(CFG_R))
+    assert int(s3.log_len[0]) == 1
+    assert int(s3.log_val[0, 0]) == 50
+    assert int(s3.client_pend) == NIL
+    assert int(info2.cmds_injected) == 1
+
+
+def test_leaderless_offer_bounces_to_random_peer():
+    """No leader known: redirect to a random peer (core.clj:154) and keep the
+    command in flight."""
+    s = base_state(CFG_R)  # all followers, leader_id NIL everywhere
+    s2, info = step(CFG_R, s, offer_inputs(CFG_R, 50, target=2, bounce=3))
+    assert int(info.cmds_injected) == 0
+    assert int(s2.client_pend) == 50
+    assert int(s2.client_dst) == 3
+    assert int(jnp.max(s2.log_len)) == 0
+
+
+def test_busy_client_drops_fresh_offers():
+    """One command in flight at a time: a new offer while one is pending is
+    dropped (the one-curl-at-a-time reference client)."""
+    s = make_leader(base_state(CFG_R), 0, 2)
+    s = s._replace(client_pend=jnp.int32(50), client_dst=jnp.int32(0))
+    s2, info = step(CFG_R, s, offer_inputs(CFG_R, 60, target=0))
+    # the pending 50 lands; the fresh 60 is dropped, not queued
+    assert int(s2.log_len[0]) == 1
+    assert int(s2.log_val[0, 0]) == 50
+    assert int(s2.client_pend) == NIL
+    assert int(info.cmds_injected) == 1
+
+
+def test_dead_target_bounces_instead_of_trusting_its_leader():
+    """A POST to a crashed node fails; the client retries a random peer rather
+    than following the dead node's stale leader pointer."""
+    s = make_leader(base_state(CFG_R), 0, 2)
+    inp = offer_inputs(CFG_R, 50, target=2, bounce=4)._replace(
+        alive=jnp.ones((CFG_R.n_nodes,), bool).at[2].set(False)
+    )
+    s2, _ = step(CFG_R, s, inp)
+    assert int(s2.client_pend) == 50
+    assert int(s2.client_dst) == 4  # bounce, not node 2's leader_id
+
+
+def test_commit_latency_metric_direct_vs_redirect():
+    """p50_commit_latency is live on client workloads and the redirect model pays
+    at least the direct model's latency (each bounce costs a tick)."""
+    base = dict(n_nodes=5, client_interval=4)
+    _, m_direct = scan.simulate(RaftConfig(**base), 0, 32, 300)
+    _, m_redir = scan.simulate(RaftConfig(**base, client_redirect=True), 0, 32, 300)
+    s_direct = summarize(m_direct)
+    s_redir = summarize(m_redir)
+    assert s_direct.p50_commit_latency is not None
+    assert s_redir.p50_commit_latency is not None
+    # commit takes at least a full replicate+ack round trip
+    assert s_direct.p50_commit_latency >= 2
+    assert s_redir.p50_commit_latency >= s_direct.p50_commit_latency
+    # redirect still delivers: commands were accepted and committed fleet-wide
+    assert s_redir.total_cmds > 0
+    m = jax.device_get(m_redir)
+    assert int(np.sum(m.violations)) == 0
+
+
+def test_no_latency_metric_without_client_traffic():
+    _, m = scan.simulate(RaftConfig(n_nodes=5), 0, 8, 100)
+    s = summarize(m)
+    assert s.p50_commit_latency is None
+    assert int(np.sum(jax.device_get(m).lat_cnt)) == 0
+
+
+def test_session_offer_reports_committed():
+    from raft_sim_tpu.driver import Session
+
+    sess = Session(RaftConfig(n_nodes=5), batch=8, seed=0)
+    sess.run(60)  # elect leaders everywhere first
+    res = sess.offer(777, wait=20)
+    assert res["accepted"] == 8
+    assert res["committed"] == 8
+    assert res["waited"] >= 1  # commit takes a replication round trip

@@ -36,6 +36,8 @@ def quiet_inputs(cfg, far=1000):
         skew=jnp.ones((n,), jnp.int32),
         timeout_draw=jnp.full((n,), far, jnp.int32),
         client_cmd=jnp.int32(NIL),
+        client_target=jnp.int32(0),
+        client_bounce=jnp.int32(0),
         alive=jnp.ones((n,), bool),
         restarted=jnp.zeros((n,), bool),
     )

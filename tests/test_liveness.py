@@ -32,6 +32,8 @@ def run_ticks(cfg, s, n_ticks, alive, cmd_base=100):
             skew=jnp.ones((n,), jnp.int32),
             timeout_draw=jnp.full((n,), 8 + (t % 5), jnp.int32),
             client_cmd=jnp.int32(cmd_base + t),
+            client_target=jnp.int32(0),
+            client_bounce=jnp.int32(0),
             alive=jnp.asarray(alive, bool),
             restarted=jnp.zeros((n,), bool),
         )
@@ -75,6 +77,8 @@ def test_healed_laggard_catches_up():
         skew=jnp.ones((n,), jnp.int32),
         timeout_draw=jnp.full((n,), 9, jnp.int32),
         client_cmd=jnp.int32(NIL),
+        client_target=jnp.int32(0),
+        client_bounce=jnp.int32(0),
         alive=jnp.ones((n,), bool),
         restarted=jnp.asarray([i == 4 for i in range(n)], bool),
     )

@@ -105,6 +105,21 @@ CONFIGS = [
         id="n5-compaction-snap",  # crashed nodes fall below the leader's base and
         # catch up via the InstallSnapshot sentinel (keep AND wipe paths)
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=2,
+            client_redirect=True,
+            drop_prob=0.2,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        8,
+        id="n5-redirect",  # the 302 write path: random targets, redirect bounces,
+        # leaderless random-peer fallback, busy-client drops -- under faults
+    ),
 ]
 
 
