@@ -3,9 +3,12 @@
 Prints ONE JSON line. The headline fields {"metric", "value", "unit", "vs_baseline"}
 are the north-star workload (config3: 100k x 5-node clusters, randomized election
 timeouts; target >=1M cluster-ticks/sec/chip, BASELINE.json `north_star`); the
-"matrix" field carries one row per BASELINE config 3/4/5 with throughput AND the
-north-star quality metric (p50 ticks-to-stable-leader) plus safety-violation counts.
-The reference publishes no numbers of its own (SURVEY.md section 6).
+"matrix" field carries one row per BASELINE config (all five: config1 is the
+single-cluster 10k-tick correctness reference with log matching checked every
+tick, config2 the 1k-cluster vmap row, 3-5 the throughput/fault rows) with
+throughput AND the quality metrics (p50 ticks-to-stable-leader, p50 offer->commit
+latency, accepted-command and safety-violation counts). The reference publishes no
+numbers of its own (SURVEY.md section 6).
 
 Two timing traps on this machine's TPU stack, both defended here:
   1. it caches identical (program, args) executions, so every timed repeat uses a
@@ -38,9 +41,18 @@ from raft_sim_tpu.sim import scan
 NORTH_STAR = 1_000_000.0  # cluster-ticks/sec/chip, BASELINE.json north_star
 
 # config -> ticks per timed call (bounded so one call stays watchdog-safe even at
-# full batch; config5's N=51 tick is ~100x a 5-node tick).
-MATRIX_TICKS = {"config3": 500, "config4": 300, "config5": 200}
-SMOKE_BATCH = {"config3": 512, "config4": 256, "config5": 16}
+# full batch; config5's N=51 tick is ~100x a 5-node tick). config1 runs its full
+# BASELINE 10k-tick soak (single cluster -- the correctness row, not a
+# throughput row).
+MATRIX_TICKS = {
+    "config1": 10_000,
+    "config2": 2_000,
+    "config3": 500,
+    "config4": 300,
+    "config5": 200,
+}
+SMOKE_BATCH = {"config2": 64, "config3": 512, "config4": 256, "config5": 16}
+SMOKE_TICKS = {"config1": 1_000}
 
 
 def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2) -> dict:
@@ -71,6 +83,7 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2) -> dict:
         "p50_stable_tick": s.p50_stable_tick,
         "pct_stable": round(100.0 * s.n_stable / s.n_clusters, 1),
         "p50_commit_latency": s.p50_commit_latency,
+        "total_cmds": s.total_cmds,
         "violations": s.total_violations,
     }
 
@@ -86,13 +99,21 @@ def main() -> None:
                     help="CPU-sized shrink (small batches) of the same matrix")
     args = ap.parse_args()
 
-    names = [args.preset] if args.preset else ["config3", "config4", "config5"]
+    names = (
+        [args.preset]
+        if args.preset
+        else ["config1", "config2", "config3", "config4", "config5"]
+    )
     matrix = {}
     for name in names:
         cfg, preset_batch = PRESETS[name]
         smoke_batch = SMOKE_BATCH.get(name, min(preset_batch, 256))
         batch = args.batch or (smoke_batch if args.smoke else preset_batch)
-        ticks = args.ticks or MATRIX_TICKS.get(name, 300)
+        ticks = args.ticks or (
+            SMOKE_TICKS[name]
+            if args.smoke and name in SMOKE_TICKS
+            else MATRIX_TICKS.get(name, 300)
+        )
         print(f"bench {name}: batch={batch} ticks={ticks}...", file=sys.stderr)
         matrix[name] = bench(cfg, batch, ticks, args.repeats)
 
