@@ -1,6 +1,7 @@
 from raft_sim_tpu.parallel.mesh import (
     AXIS,
     FleetSummary,
+    gather_metrics,
     init_distributed,
     make_mesh,
     simulate_sharded,
@@ -10,6 +11,7 @@ from raft_sim_tpu.parallel.mesh import (
 __all__ = [
     "AXIS",
     "FleetSummary",
+    "gather_metrics",
     "init_distributed",
     "make_mesh",
     "simulate_sharded",

@@ -1,0 +1,165 @@
+"""Multi-host execution proof: two cooperating OS processes, one global mesh.
+
+The reference's deployment shape is N cooperating OS processes (`lein run 1 2 3`
+etc., core.clj:197-203). This framework's multi-HOST analogue is pure
+orchestration -- independent clusters shard over every chip of every host -- and
+this tool proves the code path actually executes: it spawns TWO local processes
+(CPU backend, 4 virtual devices each) that form a JAX distributed cluster over a
+localhost coordinator, run `simulate_sharded` on the global 8-device mesh, gather
+metrics to every process (`parallel.gather_metrics` -- the non-addressable-shard
+path of `summarize`), and verifies process 0's result matches a single-process
+8-device run of the same (cfg, seed, batch, ticks) BIT FOR BIT (the
+device-layout-invariance property of tests/test_parallel.py, extended across
+process boundaries).
+
+Usage:
+    python tools/multihost_check.py            # orchestrates everything; prints
+                                               # one JSON verdict line, exit 0 on match
+
+Internal modes (spawned by the orchestrator; fresh interpreters are required
+because --xla_force_host_platform_device_count must precede backend init):
+    _MH_MODE=child _MH_PID={0,1} _MH_PORT=...  distributed worker
+    _MH_MODE=local                             single-process reference run
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# One meaty workload: faults + client traffic + invariants.
+CFG_KW = dict(n_nodes=5, client_interval=4, drop_prob=0.1, clock_skew_prob=0.1)
+SEED, BATCH, TICKS = 0, 16, 200
+
+
+def _run_and_dump() -> dict:
+    """Run the sharded simulation on the (possibly multi-process) global mesh and
+    return every RunMetrics field as lists, plus the fleet summary."""
+    import jax
+    import numpy as np
+
+    from raft_sim_tpu import RaftConfig
+    from raft_sim_tpu.parallel import gather_metrics, make_mesh, simulate_sharded, summarize
+
+    cfg = RaftConfig(**CFG_KW)
+    mesh = make_mesh()
+    final, metrics = simulate_sharded(cfg, SEED, BATCH, TICKS, mesh)
+    summary = summarize(metrics)._asdict()  # exercises the gather path itself
+    m = gather_metrics(metrics)
+    fields = {f: np.asarray(v).tolist() for f, v in zip(m._fields, m)}
+    return {"metrics": fields, "summary": summary}
+
+
+def child(pid: int, port: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from raft_sim_tpu.parallel import init_distributed
+
+    got_pid = init_distributed(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert got_pid == pid
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+    out = _run_and_dump()
+    if pid == 0:
+        print(json.dumps(out), flush=True)
+    jax.distributed.shutdown()
+
+
+def local() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() == 8, jax.device_count()
+    print(json.dumps(_run_and_dump()), flush=True)
+
+
+def orchestrate() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = str(s.getsockname()[1])
+    s.close()
+
+    def env_for(mode: str, n_dev: int, pid: int | None = None) -> dict:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_dev}"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["_MH_MODE"] = mode
+        env["_MH_PORT"] = port
+        if pid is not None:
+            env["_MH_PID"] = str(pid)
+        return env
+
+    me = os.path.abspath(__file__)
+    workers = [
+        subprocess.Popen(
+            [sys.executable, "-u", me],
+            env=env_for("child", 4, pid),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    ref = subprocess.Popen(
+        [sys.executable, "-u", me],
+        env=env_for("local", 8),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+    )
+
+    outs = []
+    for i, p in enumerate(workers + [ref]):
+        try:
+            out, err = p.communicate(timeout=480)
+        except subprocess.TimeoutExpired:
+            for q in workers + [ref]:
+                q.kill()
+            print(json.dumps({"match": False, "error": f"process {i} timed out"}))
+            return 1
+        if p.returncode != 0:
+            print(json.dumps({"match": False, "error": f"process {i} rc={p.returncode}",
+                              "stderr_tail": err[-2000:]}))
+            return 1
+        outs.append(out)
+
+    # Gloo prints connection banners on stdout; the JSON payload is the last line.
+    got = json.loads(outs[0].strip().splitlines()[-1])  # worker process 0
+    want = json.loads(outs[2].strip().splitlines()[-1])  # single-process reference
+    match = got == want
+    print(json.dumps({
+        "match": match,
+        "n_processes": 2,
+        "global_devices": 8,
+        "batch": BATCH,
+        "ticks": TICKS,
+        "violations": sum(got["metrics"]["violations"]),
+        "summary": got["summary"],
+    }))
+    return 0 if match else 1
+
+
+def main() -> int:
+    mode = os.environ.get("_MH_MODE")
+    if mode == "child":
+        child(int(os.environ["_MH_PID"]), os.environ["_MH_PORT"])
+        return 0
+    if mode == "local":
+        local()
+        return 0
+    return orchestrate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
