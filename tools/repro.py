@@ -1,0 +1,158 @@
+"""Violation repro/shrink tool (SURVEY.md section 4: fuzz cases must shrink).
+
+A fuzz run reports `violations > 0` as one integer across up to 100k clusters x
+millions of ticks. This tool isolates the needle: it re-runs the SAME seeded
+simulation in chunks (trajectories are pure functions of (seed, cfg), so nothing
+need be saved from the original run), stops at the first chunk containing a
+violation, picks the first offending cluster, re-runs just that cluster with full
+per-tick tracing to find the exact first violating tick, and emits
+
+  - (cluster, tick, violation kinds),
+  - the decoded event log around the violation (sim/trace.py -- the reference's
+    println trail, core.clj:182-186, for exactly the window that matters),
+  - per-node state lines at the violation tick, and
+  - a standalone CLI command that replays the offending cluster with events.
+
+Usage:
+    python tools/repro.py --preset config4 --seed 7 --ticks 20000 [--batch N]
+    python tools/repro.py --n-nodes 5 --drop-prob 0.3 --seed 3 --ticks 5000
+
+Exits 0 printing {"found": false} when the run is clean. Library entry:
+`shrink(cfg, seed, batch, n_ticks)` -- tests/test_repro.py demonstrates it
+against an artificially broken kernel (quorum - 1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+import jax
+import numpy as np
+
+from raft_sim_tpu import init_batch
+from raft_sim_tpu.sim import chunked, scan, trace
+from raft_sim_tpu.utils.config import PRESETS, RaftConfig
+
+VIOL_FIELDS = ("viol_election_safety", "viol_commit", "viol_log_matching")
+
+
+def shrink(
+    cfg: RaftConfig,
+    seed: int,
+    batch: int,
+    n_ticks: int,
+    chunk: int = 512,
+    context: int = 30,
+) -> dict | None:
+    """Isolate the first violating (cluster, tick) of a seeded run.
+
+    Returns None when no violation occurs within n_ticks; otherwise a dict with
+    cluster, tick, kinds, events (list of (tick, text) around the violation),
+    state_lines (per-node dump at the violation tick), and repro_cmd.
+    """
+    root = jax.random.key(seed)
+    k_init, k_run = jax.random.split(root)
+    state = init_batch(cfg, k_init, batch)
+    keys = jax.random.split(k_run, batch)
+
+    done = 0
+    while done < n_ticks:
+        n = min(chunk, n_ticks - done)
+        nxt_state, m = chunked._chunk(cfg, state, keys, n)
+        viol = np.asarray(m.violations)
+        if int(viol.sum()) == 0:
+            state, done = nxt_state, done + n
+            continue
+
+        # First offending cluster; replay it alone from the chunk start with
+        # full per-tick info + states (bit-identical to the batched run --
+        # tests/test_batched_parity.py).
+        cluster = int(np.argmax(viol > 0))
+        one = jax.tree.map(lambda x: x[cluster], state)
+        _, _, (infos, states) = jax.jit(
+            lambda s, k: scan.run(cfg, s, k, n, trace_states=True)
+        )(one, keys[cluster])
+        kinds_by_tick = {
+            f: np.asarray(getattr(infos, f)) for f in VIOL_FIELDS
+        }
+        bad = np.zeros(n, bool)
+        for v in kinds_by_tick.values():
+            bad |= v
+        assert bad.any(), "batched run flagged a violation the replay did not"
+        t_rel = int(np.argmax(bad))
+        tick = done + t_rel
+        kinds = [f for f, v in kinds_by_tick.items() if bool(v[t_rel])]
+
+        events = [
+            (done + t, e)
+            for t, e in trace.events(states)
+            if abs(t - t_rel) <= context
+        ]
+        n_nodes = cfg.n_nodes
+        state_lines = [trace.node_line(states, t_rel, i) for i in range(n_nodes)]
+        return {
+            "cluster": cluster,
+            "tick": tick,
+            "kinds": kinds,
+            "chunk_start": done,
+            "events": events,
+            "state_lines": state_lines,
+            "repro_cmd": _repro_cmd(cfg, seed, batch, tick),
+        }
+    return None
+
+
+def _repro_cmd(cfg: RaftConfig, seed: int, batch: int, tick: int) -> str:
+    """A standalone CLI line replaying the run up to just past the violation."""
+    flags = []
+    for f in dataclasses.fields(RaftConfig):
+        v = getattr(cfg, f.name)
+        if v != f.default:
+            flag = "--" + f.name.replace("_", "-")
+            flags.append(f"{flag} {v}")
+    return (
+        f"python -m raft_sim_tpu run --seed {seed} --batch {batch} "
+        f"--ticks {tick + 1} " + " ".join(flags)
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="repro")
+    ap.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--ticks", type=int, required=True)
+    ap.add_argument("--chunk", type=int, default=512)
+    ap.add_argument("--context", type=int, default=30)
+    from raft_sim_tpu.driver import _add_config_flags, build_config
+
+    _add_config_flags(ap)
+    args = ap.parse_args(argv)
+    cfg, batch = build_config(args)
+    if args.batch is not None:
+        batch = args.batch
+
+    res = shrink(cfg, args.seed, batch, args.ticks, chunk=args.chunk,
+                 context=args.context)
+    if res is None:
+        print(json.dumps({"found": False, "ticks": args.ticks, "batch": batch}))
+        return 0
+    events = res.pop("events")
+    lines = res.pop("state_lines")
+    print(json.dumps({"found": True, **res}))
+    print(f"--- state at tick {res['tick']} (cluster {res['cluster']}) ---",
+          file=sys.stderr)
+    for ln in lines:
+        print(ln, file=sys.stderr)
+    print("--- events around the violation ---", file=sys.stderr)
+    for t, e in events:
+        marker = " <== VIOLATION TICK" if t == res["tick"] else ""
+        print(f"tick {t:>7}  {e}{marker}", file=sys.stderr)
+    return 1  # a violation is a failure condition for scripting
+
+
+if __name__ == "__main__":
+    sys.exit(main())
