@@ -50,6 +50,25 @@ CONFIGS = {
         8,
         200,
     ),
+    # Ring compaction + snapshot catch-up + the 302-redirect client path: wide
+    # (int32) index planes, absolute-index checksums, routing state.
+    "compaction+redirect": (
+        dict(
+            n_nodes=5,
+            log_capacity=16,
+            compact_margin=8,
+            max_entries_per_rpc=4,
+            client_interval=2,
+            client_redirect=True,
+            drop_prob=0.15,
+            crash_prob=0.3,
+            crash_period=32,
+            crash_down_ticks=10,
+        ),
+        11,
+        32,
+        500,
+    ),
 }
 
 _CPU_CODE = """
