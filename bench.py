@@ -55,12 +55,21 @@ SMOKE_BATCH = {"config2": 64, "config3": 512, "config4": 256, "config5": 16}
 SMOKE_TICKS = {"config1": 1_000}
 
 
-def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2) -> dict:
-    # The warmup doubles as the QUALITY run: fixed seed 0, so p50/violations are
-    # reproducible across invocations and comparable across commits. Timed repeats
+def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
+          quality_seeds: int = 3) -> dict:
+    # Quality runs use FIXED seeds 0..quality_seeds-1 (reproducible across
+    # invocations, comparable across commits) and their per-cluster metrics are
+    # pooled, so the reported p50s sample quality_seeds x batch clusters instead
+    # of one seed's worth. The first doubles as the compile warmup. Timed repeats
     # then use time-salted seeds (capped so seed_base + r stays int32).
-    final, q_metrics = scan.simulate(cfg, 0, batch, ticks)
-    jax.block_until_ready((final, q_metrics))
+    pooled = []
+    for qs in range(quality_seeds):
+        final, m = scan.simulate(cfg, qs, batch, ticks)
+        pooled.append(jax.device_get(m))
+    q_metrics = type(pooled[0])(
+        *(np.concatenate([np.asarray(getattr(m, f)) for m in pooled])
+          for f in pooled[0]._fields)
+    )
 
     seed_base = int(time.time_ns() % ((1 << 31) - 1 - repeats))
     best = float("inf")
@@ -71,7 +80,7 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2) -> dict:
         np.asarray(metrics.ticks)
         best = min(best, time.perf_counter() - t0)
 
-    s = summarize(q_metrics)  # quality metrics from the fixed-seed run
+    s = summarize(q_metrics)  # pooled fixed-seed quality metrics
     value = batch * ticks / best
     return {
         "cluster_ticks_per_s": round(value, 1),
@@ -85,6 +94,7 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2) -> dict:
         "p50_commit_latency": s.p50_commit_latency,
         "total_cmds": s.total_cmds,
         "violations": s.total_violations,
+        "quality_seeds": quality_seeds,
     }
 
 
