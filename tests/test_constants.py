@@ -16,6 +16,28 @@ def test_ack_age_sat_matches():
     assert oracle.ACK_AGE_SAT == config.ACK_AGE_SAT == types.ACK_AGE_SAT
 
 
+def test_noop_sentinel_matches():
+    assert oracle.NOOP == types.NOOP
+    assert types.NOOP != types.NIL  # distinct sentinels
+
+
+def test_chk_weights_at_extends_chk_weights():
+    """The absolute-index weight form (ring compaction) agrees with the per-slot
+    form on the first CAP indices and with the oracle far beyond them."""
+    import jax.numpy as jnp
+
+    cap = 32
+    w_t, w_v = log_ops.chk_weights(cap)
+    w_t2, w_v2 = log_ops.chk_weights_at(jnp.arange(cap, dtype=jnp.uint32))
+    np.testing.assert_array_equal(np.asarray(w_t), np.asarray(w_t2))
+    np.testing.assert_array_equal(np.asarray(w_v), np.asarray(w_v2))
+    far = np.array([100, 5000, 2**20, 2**31 - 1], dtype=np.uint32)
+    g_t, g_v = log_ops.chk_weights_at(jnp.asarray(far))
+    want = np.array([oracle.chk_weights(int(a)) for a in far], dtype=np.uint32)
+    np.testing.assert_array_equal(np.asarray(g_t), want[:, 0])
+    np.testing.assert_array_equal(np.asarray(g_v), want[:, 1])
+
+
 def test_chk_weights_match():
     cap = 64
     w_t, w_v = log_ops.chk_weights(cap)

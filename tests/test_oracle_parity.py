@@ -120,6 +120,22 @@ CONFIGS = [
         id="n5-redirect",  # the 302 write path: random targets, redirect bounces,
         # leaderless random-peer fallback, busy-client drops -- under faults
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            client_interval=2,
+            client_redirect=True,
+            drop_prob=0.2,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        9,
+        id="n5-redirect-compaction",  # routing state and election no-ops riding
+        # the compaction ring (the full round-4 feature interaction)
+    ),
 ]
 
 

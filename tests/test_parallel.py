@@ -29,6 +29,31 @@ def test_sharded_matches_single_device():
         np.testing.assert_array_equal(a, b)
 
 
+def test_sharded_matches_single_device_compaction_redirect():
+    """Device-count invariance holds for the full round-4 feature surface: ring
+    compaction (wide index planes, snapshot wire header) + redirect routing."""
+    cfg = RaftConfig(
+        n_nodes=5,
+        log_capacity=8,
+        compact_margin=4,
+        client_interval=2,
+        client_redirect=True,
+        drop_prob=0.2,
+        crash_prob=0.4,
+        crash_period=16,
+        crash_down_ticks=8,
+    )
+    batch, ticks = 32, 150
+    f1, m1 = scan.simulate(cfg, 5, batch, ticks)
+    f8, m8 = simulate_sharded(cfg, 5, batch, ticks, make_mesh())
+    for a, b in zip(jax.tree.leaves(jax.device_get(m1)), jax.tree.leaves(jax.device_get(m8))):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(f1)), jax.tree.leaves(jax.device_get(f8))):
+        np.testing.assert_array_equal(a, b)
+    # compaction really ran (absolute indices far past the ring)
+    assert int(np.max(np.asarray(jax.device_get(f8).log_base))) > cfg.log_capacity
+
+
 def test_sharded_output_is_sharded():
     cfg = RaftConfig(n_nodes=3)
     mesh = make_mesh()
