@@ -130,10 +130,17 @@ class Session:
         cfg.client_redirect the kernel routes it through the 302 redirect dance).
         Overrides that tick's scheduled client input, metrics accumulate as in
         run(). Returns {"accepted", "committed", "waited"}: `accepted` counts
-        clusters whose live leader appended the value; `committed` counts clusters
-        where the value has COMMITTED after up to `wait` further ticks -- the ack
-        the reference's commit watch was meant to deliver and never did
-        (log.clj:83-87, bug 2.3.9).
+        clusters whose live leader appended the value ON the offer tick (under
+        client_redirect acceptance usually lands on a LATER tick, after the
+        bounces, so this undercounts there -- watch `committed` instead);
+        `committed` counts clusters where the value NEWLY committed relative to a
+        pre-offer snapshot, after up to `wait` further ticks -- the ack the
+        reference's commit watch was meant to deliver and never did
+        (log.clj:83-87, bug 2.3.9). Scheduled commands encode their offer tick as
+        their value, so prefer values outside that range (e.g. <= -3) when
+        client_interval > 0: a colliding value can be indistinguishable from an
+        already-committed scheduled entry (the snapshot makes that a conservative
+        undercount, never a false positive).
         """
         value = int(value)
         from raft_sim_tpu.types import NIL, NOOP
@@ -144,20 +151,27 @@ class Session:
             )
         if not -(2**31) <= value < 2**31:
             raise ValueError(f"command value must fit int32, got {value}")
+        before = self._committed_mask(value)
         self.state, self.metrics, accepted = _offer_tick(
             self.cfg, self.state, self.keys, self.metrics, value
         )
         accepted = int(np.sum(np.asarray(accepted)))
-        committed, waited = self._count_committed(value), 0
-        while waited < wait and committed < accepted:
+        fresh = lambda: int((self._committed_mask(value) & ~before).sum())
+        committed, waited = fresh(), 0
+        # Direct mode: commitment can only reach the same-tick acceptance count.
+        # Redirect mode: acceptance trickles in over the bounces, so keep
+        # stepping until every cluster committed or the wait budget runs out.
+        goal = self.batch if self.cfg.client_redirect else accepted
+        while waited < wait and committed < goal:
             self.run(1, chunk=1)
             waited += 1
-            committed = self._count_committed(value)
+            committed = fresh()
         return {"accepted": accepted, "committed": committed, "waited": waited}
 
-    def _count_committed(self, value: int) -> int:
-        """Clusters in which `value` is a committed live entry (host-side scan of
-        the ring; entries compacted past the base are no longer attributable)."""
+    def _committed_mask(self, value: int) -> np.ndarray:
+        """[batch] bool: clusters in which `value` is a committed live entry
+        (host-side ring scan; entries compacted past the base are no longer
+        attributable)."""
         st = jax.device_get(self.state)
         lv = np.asarray(st.log_val)  # [B, N, CAP]
         commit = np.asarray(st.commit_index)[:, :, None]
@@ -166,7 +180,7 @@ class Session:
         sl = np.arange(cap)[None, None, :]
         abs1 = base + (sl - base) % cap + 1  # absolute 1-based index per slot
         hit = (lv == value) & (abs1 > base) & (abs1 <= commit)
-        return int(np.any(hit, axis=(1, 2)).sum())
+        return np.any(hit, axis=(1, 2))
 
     def trace(self, n_ticks: int, cluster: int = 0):
         """Step a single selected cluster with full per-tick info + states captured

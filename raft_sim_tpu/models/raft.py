@@ -401,8 +401,20 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     if cfg.client_interval > 0:
         sl = jnp.arange(cap, dtype=jnp.int32)[None, :]
         abs1 = (base[:, None] + (sl - base[:, None]) % cap + 1) if comp else (sl + 1)
-        newly = (abs1 > s.commit_index[:, None]) & (abs1 <= commit[:, None])
-        lm = (is_leader & inp.alive)[:, None] & newly & (log_val_arr != NOOP)
+        # Dedup across leader changes: a freshly elected leader's own commit
+        # trails the cluster's prior frontier and would re-count entries its
+        # predecessor already reported, so only entries above the cluster-wide
+        # old frontier contribute. Only plausibly tick-encoded values count
+        # (offer ticks lie in (0, now)): manual Session.offer payloads outside
+        # that range are excluded instead of decoding as garbage latencies.
+        frontier = jnp.maximum(s.commit_index, jnp.max(s.commit_index))
+        newly = (abs1 > frontier[:, None]) & (abs1 <= commit[:, None])
+        lm = (
+            (is_leader & inp.alive)[:, None]
+            & newly
+            & (log_val_arr >= 1)
+            & (log_val_arr <= s.now)
+        )
         lat_sum = jnp.sum(jnp.where(lm, s.now - log_val_arr + 1, 0)).astype(jnp.int32)
         lat_cnt = jnp.sum(lm).astype(jnp.int32)
     else:

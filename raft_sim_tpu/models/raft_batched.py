@@ -358,8 +358,17 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             abs1 = base[:, None, :] + (sl - base[:, None, :]) % cap + 1
         else:
             abs1 = sl + 1
-        newly = (abs1 > s.commit_index[:, None, :]) & (abs1 <= commit[:, None, :])
-        lm = (is_leader & inp.alive)[:, None, :] & newly & (log_val_arr != NOOP)
+        # Frontier dedup + tick-encoded value gate (raft.py).
+        frontier = jnp.maximum(
+            s.commit_index, jnp.max(s.commit_index, axis=0, keepdims=True)
+        )  # [N, B]
+        newly = (abs1 > frontier[:, None, :]) & (abs1 <= commit[:, None, :])
+        lm = (
+            (is_leader & inp.alive)[:, None, :]
+            & newly
+            & (log_val_arr >= 1)
+            & (log_val_arr <= s.now[None, None, :])
+        )
         lat_sum = jnp.sum(
             jnp.where(lm, s.now[None, None, :] - log_val_arr + 1, 0), axis=(0, 1)
         ).astype(jnp.int32)

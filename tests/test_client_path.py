@@ -128,3 +128,44 @@ def test_session_offer_reports_committed():
     assert res["accepted"] == 8
     assert res["committed"] == 8
     assert res["waited"] >= 1  # commit takes a replication round trip
+
+
+def test_session_offer_reports_committed_under_redirect():
+    """Under client_redirect, acceptance lands after the 302 bounces, so the
+    same-tick `accepted` undercounts -- the commitment loop must keep stepping
+    anyway (code-review finding)."""
+    from raft_sim_tpu.driver import Session
+
+    sess = Session(RaftConfig(n_nodes=5, client_redirect=True), batch=8, seed=0)
+    sess.run(60)
+    res = sess.offer(-7, wait=40)
+    assert res["committed"] == 8  # every cluster committed the redirected offer
+    assert res["accepted"] < 8  # ~1/5 of targets hit the leader on tick one
+
+
+def test_session_offer_value_collision_never_false_positives():
+    """A value colliding with an already-committed scheduled command (values
+    encode offer ticks) must not be reported as this offer's commitment: the
+    pre-offer snapshot makes collisions a conservative undercount
+    (code-review finding)."""
+    from raft_sim_tpu.driver import Session
+
+    sess = Session(RaftConfig(n_nodes=5, client_interval=8), batch=8, seed=0)
+    sess.run(200)  # scheduled value 41 (offer tick 40) committed long ago
+    res = sess.offer(41, wait=0)
+    assert res["committed"] == 0
+
+
+def test_manual_offer_values_do_not_corrupt_latency_metric():
+    """Arbitrary Session.offer payloads must not decode as offer ticks in the
+    latency accumulator (code-review finding: a large or negative value would
+    skew p50_commit_latency wildly)."""
+    from raft_sim_tpu.driver import Session
+
+    sess = Session(RaftConfig(n_nodes=5, client_interval=8), batch=8, seed=0)
+    sess.run(100)
+    sess.offer(-1000, wait=20)
+    sess.run(50)
+    after = sess.summary()["p50_commit_latency"]
+    assert after is not None
+    assert 1 <= after <= 10  # still the ordinary replication round trip
