@@ -193,3 +193,43 @@ def test_kitchen_sink_all_faults_at_once():
     assert int(m.violations.sum()) == 0
     assert int((m.first_leader_tick < NEVER).sum()) > 32
     assert int(m.max_commit.max()) > 0
+
+
+def test_kitchen_sink_with_compaction_and_redirect():
+    """The round-4 surface under the same everything-at-once fault mix: a small
+    compaction ring (absolute indices, snapshots, election no-ops) fed through
+    the 302-redirect client path, ring-aware log matching checked every tick.
+    Also pins batch-size invariance for the new client/compaction state."""
+    cfg = RaftConfig(
+        n_nodes=5,
+        log_capacity=16,
+        compact_margin=4,
+        max_entries_per_rpc=4,
+        client_interval=2,
+        client_redirect=True,
+        drop_prob=0.3,
+        drop_prob_uniform=True,
+        clock_skew_prob=0.15,
+        partition_period=40,
+        partition_prob=0.5,
+        crash_prob=0.3,
+        crash_period=40,
+        crash_down_ticks=15,
+        check_log_matching=True,
+    )
+    m = metrics_of(cfg, 12, 64, 600)
+    assert int(m.violations.sum()) == 0
+    assert int((m.first_leader_tick < NEVER).sum()) > 32
+    # the ring really wrapped under fire somewhere in the fleet
+    assert int(m.max_commit.max()) > cfg.log_capacity
+    # cluster trajectories (incl. client_pend/log_base state) are batch-invariant
+    small_f, small_m = scan.simulate(cfg, 12, 4, 200)
+    big_f, big_m = scan.simulate(cfg, 12, 64, 200)
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(small_f)), jax.tree.leaves(jax.device_get(big_f))
+    ):
+        np.testing.assert_array_equal(a, b[:4])
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(small_m)), jax.tree.leaves(jax.device_get(big_m))
+    ):
+        np.testing.assert_array_equal(a, b[:4])
