@@ -33,8 +33,17 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
-# One meaty workload: faults + client traffic + invariants.
-CFG_KW = dict(n_nodes=5, client_interval=4, drop_prob=0.1, clock_skew_prob=0.1)
+# One meaty workload: faults + client traffic + invariants, riding the full
+# round-4 surface (compaction ring + snapshot catch-up + 302 redirect routing).
+CFG_KW = dict(
+    n_nodes=5,
+    log_capacity=16,
+    compact_margin=4,
+    client_interval=4,
+    client_redirect=True,
+    drop_prob=0.1,
+    clock_skew_prob=0.1,
+)
 SEED, BATCH, TICKS = 0, 16, 200
 
 
