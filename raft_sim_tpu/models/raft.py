@@ -70,8 +70,6 @@ from raft_sim_tpu.types import (
     Mailbox,
     StepInfo,
     StepInputs,
-    pack_resp,
-    unpack_resp,
 )
 from raft_sim_tpu.utils.config import RaftConfig
 
@@ -123,8 +121,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     deliver_req = inp.deliver_mask.T & ~eye & inp.alive[:, None] & dst_up[None, :]
     deliver_resp = inp.deliver_mask & ~eye & dst_up[:, None] & inp.alive[None, :]
     req_in = deliver_req & (mb.req_type != 0)[:, None]  # [sender, receiver]
-    r_type, r_ok, r_match = unpack_resp(mb.resp_word)
-    resp_in = deliver_resp & (r_type != 0)  # [receiver, responder]
+    resp_in = deliver_resp & (mb.resp_kind != 0)  # [receiver, responder]
 
     # ---- phase 1: term adoption --------------------------------------------------
     # Spec: any RPC (request or response) with term T > currentTerm -> set
@@ -169,8 +166,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     voted_for = jnp.where((voted_for == NIL) & granted_any, lowest, voted_for)
     # Every delivered RV gets a response carrying our (possibly just-adopted) term;
     # [candidate, voter] is already the response orientation [receiver, responder].
+    # The grant itself is per RESPONDER: at most one candidate per tick (Mailbox),
+    # and a grant always targets the post-update voted_for (re-grants re-name it,
+    # fresh grants just set it) -- no reduction over the grant plane needed. Safe
+    # to read here: phase 7 cannot rebind voted_for for a granter this tick (a
+    # grant resets the election deadline to clock + draw > clock, so the granter
+    # cannot also expire).
     vr_out = is_rv
-    vr_granted = grant
+    grant_to = jnp.where(granted_any, voted_for, NIL).astype(jnp.int8)  # [N]
 
     # ---- phase 3: AppendEntries requests (append-entries-handler, core.clj:105-123) --
     is_ae = req_in & (mb.req_type == REQ_APPEND)[:, None]  # [leader, follower]
@@ -310,27 +313,28 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # down 1 per nack while client traffic grows its log ~1 per tick, and under
     # recurring crash churn no current-term entry ever reaches quorum (measured
     # livelock: commit frozen for thousands of ticks).
-    # [leader, follower] is already the response orientation [receiver, responder].
+    # [leader, follower] is already the response orientation [receiver, responder];
+    # the payload is per responder (at most one success target -- Mailbox).
     ar_out = is_ae
     if comp:
-        ar_success = sel & (ae_ok | snap)[None, :]
-        ok_match = jnp.where(
-            sel & snap[None, :],
-            L[None, :],
-            jnp.where(sel & ae_ok[None, :], last_new[None, :], 0),
-        )
+        a_ok = ae_ok | snap
+        out_a_match = jnp.where(snap, L, jnp.where(ae_ok, last_new, 0))
     else:
-        ar_success = sel & ae_ok[None, :]
-        ok_match = jnp.where(ar_success, last_new[None, :], 0)
-    ar_match = jnp.where(ar_out & ~ar_success, log_len[None, :], ok_match)
+        a_ok = ae_ok
+        out_a_match = jnp.where(ae_ok, last_new, 0)
+    idt = s.next_index.dtype
+    out_a_ok_to = jnp.where(a_ok, ae_src, NIL).astype(jnp.int8)  # NIL = no success
+    out_a_match = out_a_match.astype(idt)  # bounded by the responder's log length
+    out_a_hint = log_len.astype(idt)  # post-append, pre-injection (phase 6 rebinds)
 
     # ---- phase 4: responses ------------------------------------------------------
     # Vote tally (vote-response-handler core.clj:125-139; dedup via bitmap mirrors the
-    # reference's set, core.clj:133-134).
-    vresp = resp_in & (r_type == RESP_VOTE)
+    # reference's set, core.clj:133-134). Granted = this responder's one grant
+    # (v_to) names me (Mailbox response decode).
+    vresp = resp_in & (mb.resp_kind == RESP_VOTE)
     new_votes = (
         vresp
-        & (r_ok != 0)
+        & (mb.v_to[None, :] == ids[:, None])
         & (mb.resp_term[None, :] == term[:, None])
         & (role == CANDIDATE)[:, None]
     )
@@ -352,21 +356,22 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # log-index, bug 2.3.10); failure: decrement next-index and retry (core.clj:146).
     aresp = (
         resp_in
-        & (r_type == RESP_APPEND)
+        & (mb.resp_kind == RESP_APPEND)
         & (role == LEADER)[:, None]
         & (mb.resp_term[None, :] == term[:, None])
     )
-    a_succ = aresp & (r_ok != 0)
-    a_fail = aresp & (r_ok == 0)
-    match_index = jnp.where(a_succ, jnp.maximum(match_index, r_match), match_index)
+    ok_mine = mb.a_ok_to[None, :] == ids[:, None]  # responder's one success names me
+    a_succ = aresp & ok_mine
+    a_fail = aresp & ~ok_mine
+    am = mb.a_match[None, :]  # already index_dtype (bounded by log length)
+    ah = mb.a_hint[None, :]
+    match_index = jnp.where(a_succ, jnp.maximum(match_index, am), match_index)
+    next_index = jnp.where(a_succ, jnp.maximum(next_index, am + 1), next_index)
+    # Failure: back off to min(next-1, hint+1) -- the nack hint is the responder's
+    # log length (phase 3), so a far-behind or just-elected leader's probe
+    # converges in one round trip instead of one slot per nack.
     next_index = jnp.where(
-        a_succ, jnp.maximum(next_index, r_match + 1), next_index
-    )
-    # Failure: back off to min(next-1, hint+1) -- the nack's match field carries
-    # the responder's log length (phase 3), so a far-behind or just-elected
-    # leader's probe converges in one round trip instead of one slot per nack.
-    next_index = jnp.where(
-        a_fail, jnp.maximum(jnp.minimum(next_index - 1, r_match + 1), 1), next_index
+        a_fail, jnp.maximum(jnp.minimum(next_index - 1, ah + 1), 1), next_index
     )
     # Responsiveness ages for the shared-window filter (phase 8): everyone ages one
     # tick (saturating); any AE response (success or failure) proves the peer is up
@@ -608,10 +613,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
 
     # Responses: vr_out/ar_out are [request-sender, request-receiver], which IS the
     # response orientation [response-receiver, responder] (the reference's resp-chan
-    # round trip, server.clj:59-60 -> client.clj:34-40), packed into one word; the
-    # responder's term rides per responder (same value toward every requester).
-    out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match, wide=comp)
+    # round trip, server.clj:59-60 -> client.clj:34-40); the edge plane carries only
+    # the response TYPE -- payloads are per responder (Mailbox response decode).
+    out_resp_kind = (
+        jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
+    ).astype(jnp.int8)
     pterm = (
         log_ops.term_at_r(log_term_arr, base, bterm, ws)
         if comp
@@ -637,7 +643,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
             jnp.where(send_append, bchk, jnp.uint32(0)) if comp else mb.req_base_chk
         ),
         req_off=out_req_off,
-        resp_word=out_resp_word,
+        resp_kind=out_resp_kind,
+        v_to=grant_to,
+        a_ok_to=out_a_ok_to,
+        a_match=out_a_match,
+        a_hint=out_a_hint,
         resp_term=term,
     )
 

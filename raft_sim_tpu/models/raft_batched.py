@@ -35,8 +35,6 @@ from raft_sim_tpu.types import (
     Mailbox,
     StepInfo,
     StepInputs,
-    pack_resp,
-    unpack_resp,
 )
 from raft_sim_tpu.utils.config import RaftConfig
 
@@ -97,8 +95,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     )  # [N, N, B]
     deliver_resp = inp.deliver_mask & ~eye3 & dst_up[:, None, :] & inp.alive[None, :, :]
     req_in = deliver_req & (mb.req_type != 0)[:, None, :]
-    r_type, r_ok, r_match = unpack_resp(mb.resp_word)
-    resp_in = deliver_resp & (r_type != 0)
+    resp_in = deliver_resp & (mb.resp_kind != 0)
 
     # ---- phase 1: term adoption --------------------------------------------------
     in_term = jnp.maximum(
@@ -136,7 +133,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     granted_any = jnp.any(grant, axis=0)  # [N, B]
     voted_for = jnp.where((voted_for == NIL) & granted_any, lowest, voted_for)
     vr_out = is_rv  # [candidate, voter] = response orientation [receiver, responder]
-    vr_granted = grant
+    # Grant target = post-update voted_for (raft.py phase 2: no reduction needed).
+    grant_to = jnp.where(granted_any, voted_for, NIL).astype(jnp.int8)  # [N, B]
 
     # ---- phase 3: AppendEntries requests ------------------------------------------
     is_ae = req_in & (mb.req_type == REQ_APPEND)[:, None, :]  # [leader, follower, B]
@@ -261,27 +259,26 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         apply_snap = snap
 
     # [leader, follower] is already the response orientation [receiver, responder]
-    # (snapshot installs always ack, with match = the snapshot index). A NACK's
-    # match field carries the responder's log length as the conflict-index
-    # catch-up hint (raft.py phase 3).
+    # (snapshot installs always ack, with match = the snapshot index); the payload
+    # is per responder -- at most one success target, one shared nack hint
+    # (raft.py phase 3, Mailbox docstring).
     ar_out = is_ae
     if comp:
-        ar_success = sel & (ae_ok | snap)[None, :, :]
-        ok_match = jnp.where(
-            sel & snap[None, :, :],
-            L[None, :, :],
-            jnp.where(sel & ae_ok[None, :, :], last_new[None, :, :], 0),
-        )
+        a_ok = ae_ok | snap
+        out_a_match = jnp.where(snap, L, jnp.where(ae_ok, last_new, 0))
     else:
-        ar_success = sel & ae_ok[None, :, :]
-        ok_match = jnp.where(ar_success, last_new[None, :, :], 0)
-    ar_match = jnp.where(ar_out & ~ar_success, log_len[None, :, :], ok_match)
+        a_ok = ae_ok
+        out_a_match = jnp.where(ae_ok, last_new, 0)
+    idt = s.next_index.dtype
+    out_a_ok_to = jnp.where(a_ok, ae_src, NIL).astype(jnp.int8)  # NIL = no success
+    out_a_match = out_a_match.astype(idt)  # bounded by the responder's log length
+    out_a_hint = log_len.astype(idt)  # post-append, pre-injection (phase 6 rebinds)
 
     # ---- phase 4: responses ------------------------------------------------------
-    vresp = resp_in & (r_type == RESP_VOTE)
+    vresp = resp_in & (mb.resp_kind == RESP_VOTE)
     new_votes = (
         vresp
-        & (r_ok != 0)
+        & (mb.v_to[None, :, :] == ids2[:, None, :])
         & (mb.resp_term[None, :, :] == term[:, None, :])
         & (role == CANDIDATE)[:, None, :]
     )
@@ -299,17 +296,20 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
 
     aresp = (
         resp_in
-        & (r_type == RESP_APPEND)
+        & (mb.resp_kind == RESP_APPEND)
         & (role == LEADER)[:, None, :]
         & (mb.resp_term[None, :, :] == term[:, None, :])
     )
-    a_succ = aresp & (r_ok != 0)
-    a_fail = aresp & (r_ok == 0)
-    match_index = jnp.where(a_succ, jnp.maximum(match_index, r_match), match_index)
-    next_index = jnp.where(a_succ, jnp.maximum(next_index, r_match + 1), next_index)
+    ok_mine = mb.a_ok_to[None, :, :] == ids2[:, None, :]
+    a_succ = aresp & ok_mine
+    a_fail = aresp & ~ok_mine
+    am = mb.a_match[None, :, :]  # already index_dtype (bounded by log length)
+    ah = mb.a_hint[None, :, :]
+    match_index = jnp.where(a_succ, jnp.maximum(match_index, am), match_index)
+    next_index = jnp.where(a_succ, jnp.maximum(next_index, am + 1), next_index)
     # Failure: back off to min(next-1, hint+1) (conflict-index hint; raft.py).
     next_index = jnp.where(
-        a_fail, jnp.maximum(jnp.minimum(next_index - 1, r_match + 1), 1), next_index
+        a_fail, jnp.maximum(jnp.minimum(next_index - 1, ah + 1), 1), next_index
     )
     # Responsiveness ages for the shared-window filter (phase 8; see raft.py).
     ack_age = jnp.minimum(s.ack_age + 1, ACK_AGE_SAT)
@@ -520,12 +520,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_ent_term = jnp.where(ship_used, wt, 0)
     out_ent_val = jnp.where(ship_used, wv, 0)
 
-    # Responses [receiver, responder] pack into one word; the responder's term is a
-    # per-responder field (same value toward every requester). The outbox is
-    # transpose-free and now also broadcast-free: nothing [N, N]-shaped is written
-    # beyond the offset and response planes.
-    out_resp_type = jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
-    out_resp_word = pack_resp(out_resp_type, vr_granted | ar_success, ar_match, wide=comp)
+    # Responses [receiver, responder]: the edge plane carries only the response
+    # TYPE; payloads (grant target, ack target, match, hint, term) are per
+    # responder (Mailbox response decode). The outbox is transpose-free and
+    # broadcast-free: nothing [N, N]-shaped is written beyond the offset and
+    # response-kind planes, both int8.
+    out_resp_kind = (
+        jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
+    ).astype(jnp.int8)
     if comp:
         pterm = log_ops.term_at_rb(log_term_arr, base, bterm, ws)
     else:
@@ -550,7 +552,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             jnp.where(send_append, bchk, jnp.uint32(0)) if comp else mb.req_base_chk
         ),
         req_off=out_req_off,
-        resp_word=out_resp_word,
+        resp_kind=out_resp_kind,
+        v_to=grant_to,
+        a_ok_to=out_a_ok_to,
+        a_match=out_a_match,
+        a_hint=out_a_hint,
         resp_term=term,
     )
 

@@ -54,65 +54,29 @@ NIL = -1  # nil node id
 # commit gate (models/raft.py phase 6). Reserved: client commands may not use it.
 NOOP = -2
 
-# Packed response word (Mailbox.resp_word): type (2 bits) | ok << 2 | match << 3.
-# Both kernels and the checkpoint format share this layout through pack_resp/
-# unpack_resp below; tests/oracle.py re-derives it independently and
-# tests/test_constants.py pins the two against each other.
-RESP_TYPE_MASK = 3
-RESP_OK_SHIFT = 2
-RESP_MATCH_SHIFT = 3
-# Static bit-budget tie (narrow mode): resp_word is int16, so after 2 type bits +
-# 1 ok bit the packed match index gets 12 value bits + nothing to spare above the
-# sign bit. The largest packable match is the log-capacity ceiling enforced at
-# config construction -- the packing sits at exactly that limit, asserted here so
-# widening MAX_LOG_CAPACITY without widening resp_word is an import-time error.
-# Compaction configs carry ABSOLUTE (unbounded) log indices and ride the wide
-# int32 word instead: after 2 type bits + 1 ok bit and the sign bit, the packed
-# match gets 28 value bits, so runs are bounded at 2^28 ~ 268M committed entries
-# per node (the shift-by-3 of a larger match would set the sign bit and corrupt
-# the arithmetic-shift unpack).
-assert (MAX_LOG_CAPACITY << RESP_MATCH_SHIFT) + (1 << RESP_OK_SHIFT) + RESP_TYPE_MASK < 2**15
-
-
 def index_dtype(cfg: RaftConfig):
-    """Dtype of the per-edge log-index planes (next/match) and the packed response
-    word. int16 when indices are bounded by log_capacity <= 4095; int32 when
-    compaction makes indices absolute and unbounded."""
+    """Dtype of the per-edge log-index planes (next/match). int16 when indices are
+    bounded by log_capacity <= 4095; int32 when compaction makes indices absolute
+    and unbounded."""
     return jnp.int32 if cfg.compaction else jnp.int16
 
 
-def pack_resp(rtype, ok, match, wide: bool = False):
-    """Pack (type, ok, match) into the response word -- int16 (`match` a log index
-    in [0, MAX_LOG_CAPACITY]) or int32 when `wide` (compaction: absolute indices).
-    `ok` may be bool or 0/1 int."""
-    ok = jnp.asarray(ok).astype(jnp.int32)
-    return (rtype + (ok << RESP_OK_SHIFT) + (match << RESP_MATCH_SHIFT)).astype(
-        jnp.int32 if wide else jnp.int16
-    )
-
-
-def unpack_resp(word):
-    """(type, ok, match) from a response word. Works on jnp and numpy arrays."""
-    return word & RESP_TYPE_MASK, (word >> RESP_OK_SHIFT) & 1, word >> RESP_MATCH_SHIFT
-
-
 class Mailbox(NamedTuple):
-    """In-flight RPC state, one tick deep. TPU-native wire format, v8.
+    """In-flight RPC state, one tick deep. TPU-native wire format, v9.
 
     Both RPCs are logically broadcasts (the reference sends RequestVote and
     AppendEntries to every peer, core.clj:48-67), and after the shared-window prev
     clamp the only genuinely per-edge request datum is a tiny window offset. So the
     wire format carries request HEADERS per sender ([N] -- one record broadcast to
     all peers) and only two per-edge planes, cutting the [N, N]-shaped mailbox
-    traffic from ten int32 fields to two (the mailbox was the dominant HBM traffic
-    of the N=51 tick: ~5x the logical state bytes):
+    traffic from ten int32 fields to two int8 planes (the mailbox was the dominant
+    HBM traffic of the N=51 tick: ~5x the logical state bytes):
 
       req_* / ent_* headers: [N(sender)] -- receivers reduce senders over axis 0
         after outer-producting with the per-edge delivery mask.
       req_off:  [sender, receiver] -- AppendEntries per-edge window offset j.
-      resp_word: [receiver, responder] -- packed response; the response to
-        requester q from responder r lands at [q, r] directly, requesters reduce
-        over axis 1.
+      resp_kind: [receiver, responder] -- RESP_* type of the response on that
+        edge; the response payload is per RESPONDER (below).
 
     AppendEntries reconstruction at receiver d from sender s (validated against the
     usual prev checks, so spec-equivalent to an explicit per-edge header):
@@ -129,12 +93,22 @@ class Mailbox(NamedTuple):
     window start and stall replication); each peer's prev is clamped into
     [ent_start, ent_start + E], which is what makes j fit 0..E.
 
-    Responses overlay :vote-response {term,vote-granted} (core.clj:95-102) and
-    :append-response {term,success,log-index} (core.clj:109-121) in one packed
-    word: type (2 bits) | ok << 2 | match << 3, where `ok` is granted/success and
-    `match` the acknowledged log index of a successful append. The responder's
-    term rides per responder in resp_term (every requester sees the same value --
-    it is the responder's term at send time).
+    Responses carry :vote-response {term,vote-granted} (core.clj:95-102) and
+    :append-response {term,success,log-index} (core.clj:109-121). The payloads are
+    per RESPONDER, not per edge, because one responder's per-tick response surface
+    is sparse by construction: it grants at most ONE vote (phase 2's single-grant
+    rule) and acks at most ONE AppendEntries sender (phase 3 selects one
+    current-term AE; election safety allows only one), and every denial it sends
+    shares the same payload (the vote denial carries only resp_term; the AE nack's
+    catch-up hint is the responder's log length -- the same value toward every
+    sender). So requester q decodes responder r's edge [q, r] as:
+      vote:   granted = (v_to[r] == q)
+      append: success = (a_ok_to[r] == q);
+              match   = a_match[r] if success else a_hint[r]  (nack conflict hint)
+    with resp_term[r] the responder's term at send time (same toward every
+    requester). This replaces v8's per-edge packed int16/int32 response word --
+    less [N, N] traffic, and the acked index is a full int32, so nothing bounds
+    committed entries (v8's packed word capped compaction runs at 2^28).
     """
 
     req_type: jax.Array  # [N(sender)] int32 (REQ_*): this tick's broadcast, if any
@@ -156,7 +130,11 @@ class Mailbox(NamedTuple):
     req_base_term: jax.Array  # [N] int32: snapshot lastIncludedTerm
     req_base_chk: jax.Array  # [N] uint32: checksum of the compacted prefix
     req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E; -1 = snapshot
-    resp_word: jax.Array  # [N(receiver), N(responder)] int16/int32 (index_dtype): type | ok<<2 | match<<3
+    resp_kind: jax.Array  # [N(receiver), N(responder)] int8 (RESP_*): response type per edge
+    v_to: jax.Array  # [N(responder)] int8: candidate granted this tick (NIL = none)
+    a_ok_to: jax.Array  # [N(responder)] int8: AE sender acked OK this tick (NIL = none)
+    a_match: jax.Array  # [N(responder)] int16/int32 (index_dtype): acked index of the successful append
+    a_hint: jax.Array  # [N(responder)] int16/int32 (index_dtype): nack hint (responder's log length)
     resp_term: jax.Array  # [N(responder)] int32: responder's term at send time
 
 
@@ -282,7 +260,11 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         req_base_term=i(n),
         req_base_chk=jnp.zeros((n,), jnp.uint32),
         req_off=jnp.zeros((n, n), jnp.int8),
-        resp_word=jnp.zeros((n, n), index_dtype(cfg)),
+        resp_kind=jnp.zeros((n, n), jnp.int8),
+        v_to=jnp.full((n,), NIL, jnp.int8),
+        a_ok_to=jnp.full((n,), NIL, jnp.int8),
+        a_match=jnp.zeros((n,), index_dtype(cfg)),
+        a_hint=jnp.zeros((n,), index_dtype(cfg)),
         resp_term=i(n),
     )
 

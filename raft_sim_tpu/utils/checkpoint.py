@@ -42,7 +42,10 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      compaction configs widen next/match and resp_word to int32.
 # v11: client write path -- ClusterState gained client_pend/client_dst (redirect
 #      routing state), RunMetrics gained lat_sum/lat_cnt (commit latency).
-_FORMAT_VERSION = 11
+# v12: mailbox wire format v9 -- the packed per-edge response word became an int8
+#      resp_kind plane + per-responder payloads (v_to/a_ok_to/a_match/a_hint),
+#      removing the packed word's 2^28 committed-entry bound.
+_FORMAT_VERSION = 12
 
 
 def _normalize(path: str) -> str:

@@ -24,9 +24,10 @@ import dataclasses
 ACK_AGE_SAT = 30000
 
 # Upper bound on RaftConfig.log_capacity. Log indices ride int16 state planes
-# (ClusterState.next_index/match_index) and the packed response word gives the
-# acked log index exactly 12 value bits above its 3 flag bits (types.pack_resp,
-# which statically asserts this bound fits that budget -- the two are tied there).
+# (ClusterState.next_index/match_index), and the single-pass window-start min
+# (models/raft_batched.py phase 8) encodes its responsiveness fallback in the
+# int16 headroom above the largest index: it needs 16384 + MAX_LOG_CAPACITY to
+# stay below int16 max, which 4095 does with room to spare.
 MAX_LOG_CAPACITY = 4095
 
 
@@ -123,10 +124,11 @@ class RaftConfig:
     check_log_matching: bool = False
 
     def __post_init__(self):
-        assert self.n_nodes >= 2
+        # Node ids ride int8 wire fields (Mailbox v_to/a_ok_to) with NIL = -1.
+        assert 2 <= self.n_nodes <= 126
         # Narrow-dtype wire/state bounds (types.py): log indices ride int16 planes
-        # (next/match, and the packed response word gives match 12 value bits), the
-        # AE window offset rides int8, and ack ages saturate below int16 max.
+        # (next/match and the per-responder match/hint wire fields), the AE window
+        # offset rides int8, and ack ages saturate below int16 max.
         assert 1 <= self.log_capacity <= MAX_LOG_CAPACITY
         assert 1 <= self.max_entries_per_rpc <= min(self.log_capacity, 127)
         assert self.ack_timeout_ticks < ACK_AGE_SAT

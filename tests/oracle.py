@@ -38,16 +38,6 @@ def chk_weights(k: int) -> tuple[int, int]:
     return w_t, w_v
 
 
-def pack_resp(rtype: int, ok: int, match: int) -> int:
-    """The packed response word -- the oracle's statement of types.pack_resp."""
-    return rtype + (ok << 2) + (match << 3)
-
-
-def unpack_resp(word):
-    """(type, ok, match) -- the oracle's statement of types.unpack_resp."""
-    return word & 3, (word >> 2) & 1, word >> 3
-
-
 def state_to_dict(state) -> dict:
     """Host-side copy of a single-cluster ClusterState (device pytree -> numpy)."""
     d = {
@@ -129,8 +119,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     np.fill_diagonal(edge_ok, False)
     recv_up = alive & ~restarted
     req_in = edge_ok.T & alive[:, None] & recv_up[None, :] & (mb["req_type"] != 0)[:, None]
-    r_type, r_ok, r_match = unpack_resp(mb["resp_word"])
-    resp_in = edge_ok & recv_up[:, None] & alive[None, :] & (r_type != 0)
+    resp_in = edge_ok & recv_up[:, None] & alive[None, :] & (mb["resp_kind"] != 0)
 
     # ---- phase 1: term adoption
     saw_higher = np.zeros(n, bool)
@@ -152,7 +141,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # ---- phase 2: RequestVote requests
     granted_any = np.zeros(n, bool)
     vr_out = np.zeros((n, n), bool)  # [dst, src]: respond to src
-    vr_granted = np.zeros((n, n), bool)
+    v_to = np.full(n, NIL, np.int32)  # the one candidate granted this tick
     for d in range(n):
         my_last_idx = int(s["log_len"][d])
         my_last_term = term_at_ring(
@@ -176,20 +165,23 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             continue
         if voted_for[d] != NIL:
             if voted_for[d] in can:  # idempotent re-grant
-                vr_granted[d, voted_for[d]] = True
+                v_to[d] = voted_for[d]
                 granted_any[d] = True
         else:
             winner = min(can)
-            vr_granted[d, winner] = True
+            v_to[d] = winner
             granted_any[d] = True
             voted_for[d] = winner
 
-    # ---- phase 3: AppendEntries requests (incl. the InstallSnapshot analogue)
+    # ---- phase 3: AppendEntries requests (incl. the InstallSnapshot analogue).
+    # Response payloads are per RESPONDER (sparse by construction: at most one
+    # success target per tick; the nack hint is the responder's log length toward
+    # every sender -- types.Mailbox docstring).
     has_ae = np.zeros(n, bool)
     snap_applied = np.zeros(n, bool)
     ar_out = np.zeros((n, n), bool)
-    ar_success = np.zeros((n, n), bool)
-    ar_match = np.zeros((n, n), np.int32)
+    a_ok_to = np.full(n, NIL, np.int32)
+    a_match = np.zeros(n, np.int32)
     for d in range(n):
         cur = [
             src
@@ -226,8 +218,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                     log_len[d] = L
                 commit[d] = max(int(commit[d]), L)
                 snap_applied[d] = True
-            ar_success[d, src] = True
-            ar_match[d, src] = L
+            a_ok_to[d] = src
+            a_match[d] = L
             continue
 
         # Reconstruct the per-edge AE header from the sender's broadcast record plus
@@ -275,16 +267,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
 
         last_new = min(prev_i + n_acc, new_len)
         commit[d] = max(int(commit[d]), min(lcommit, last_new))
-        ar_success[d, src] = True
-        ar_match[d, src] = last_new
+        a_ok_to[d] = src
+        a_match[d] = last_new
 
     # NACK catch-up hint: every unsuccessful AE response carries the responder's
-    # (post-append) log length in its match field -- the conflict-index
-    # optimization (raft.py phase 3).
-    for d in range(n):
-        for src in range(n):
-            if ar_out[d, src] and not ar_success[d, src]:
-                ar_match[d, src] = log_len[d]
+    # (post-append) log length -- the conflict-index optimization (raft.py
+    # phase 3). Per responder: the same hint toward every nacked sender.
+    a_hint = log_len.astype(np.int32).copy()
 
     # ---- phase 4: responses
     # Everyone's ack age grows one tick (saturating); stamps below zero it.
@@ -293,8 +282,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for src in range(n):
             if (
                 resp_in[d, src]
-                and r_type[d, src] == RESP_VOTE
-                and r_ok[d, src]
+                and mb["resp_kind"][d, src] == RESP_VOTE
+                and mb["v_to"][src] == d
                 and mb["resp_term"][src] == term[d]
                 and role[d] == CANDIDATE
             ):
@@ -314,19 +303,19 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for src in range(n):
             if not (
                 resp_in[d, src]
-                and r_type[d, src] == RESP_APPEND
+                and mb["resp_kind"][d, src] == RESP_APPEND
                 and mb["resp_term"][src] == term[d]
             ):
                 continue
-            if r_ok[d, src]:
-                m = int(r_match[d, src])
+            if mb["a_ok_to"][src] == d:
+                m = int(mb["a_match"][src])
                 match_index[d, src] = max(int(match_index[d, src]), m)
                 next_index[d, src] = max(int(next_index[d, src]), m + 1)
             else:
-                # Back off to min(next-1, hint+1): the nack's match field is the
+                # Back off to min(next-1, hint+1): the nack hint is the
                 # responder's log length (conflict-index hint, raft.py phase 4).
                 next_index[d, src] = max(
-                    min(int(next_index[d, src]) - 1, int(r_match[d, src]) + 1), 1
+                    min(int(next_index[d, src]) - 1, int(mb["a_hint"][src]) + 1), 1
                 )
             # Any AE response (success or failure) proves the peer is up.
             ack_age[d, src] = 0
@@ -469,7 +458,11 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "req_base_term": z(n),
         "req_base_chk": np.zeros(n, np.uint32),
         "req_off": z(n, n),
-        "resp_word": z(n, n),
+        "resp_kind": z(n, n),
+        "v_to": v_to,
+        "a_ok_to": a_ok_to,
+        "a_match": a_match,
+        "a_hint": a_hint,
         "resp_term": z(n),
     }
     for src in range(n):
@@ -524,7 +517,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                     out["req_off"][src, dst] = -1
                 else:
                     out["req_off"][src, dst] = min(max(p, ws), ws + e) - ws
-    # Responses travel back src<->dst: responder r answers requester q.
+    # Responses travel back src<->dst: responder r answers requester q; the edge
+    # plane carries only the type, payloads ride the per-responder fields above.
     for r in range(n):
         for q in range(n):
             rtype = 0
@@ -532,9 +526,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 rtype += RESP_VOTE
             if ar_out[r, q]:
                 rtype += RESP_APPEND
-            if rtype:
-                ok = int(bool(vr_granted[r, q] or ar_success[r, q]))
-                out["resp_word"][q, r] = pack_resp(rtype, ok, int(ar_match[r, q]))
+            out["resp_kind"][q, r] = rtype
 
     return {
         "role": role,

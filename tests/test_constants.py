@@ -46,21 +46,22 @@ def test_chk_weights_match():
     np.testing.assert_array_equal(np.asarray(w_v), want[:, 1])
 
 
-def test_pack_resp_matches():
-    import jax.numpy as jnp
-
-    samples = [
-        (rtype, ok, match)
-        for rtype in (0, 1, 2, 3)
-        for ok in (0, 1)
-        for match in (0, 1, 7, 2047, config.MAX_LOG_CAPACITY)
-    ]
-    for rtype, ok, match in samples:
-        want = oracle.pack_resp(rtype, ok, match)
-        got = types.pack_resp(
-            jnp.int32(rtype), jnp.int32(ok), jnp.int32(match)
-        )
-        assert int(got) == np.int16(want), (rtype, ok, match)
-        for unpack in (types.unpack_resp, oracle.unpack_resp):
-            rt, o, m = unpack(np.int16(want))
-            assert (int(rt), int(o), int(m)) == (rtype, ok, match)
+def test_wire_constants_match():
+    """Roles, request/response kinds, and the nil sentinel -- the enums both the
+    mailbox type plane (v9) and the oracle's dispatch compare against."""
+    assert (oracle.FOLLOWER, oracle.CANDIDATE, oracle.LEADER) == (
+        types.FOLLOWER,
+        types.CANDIDATE,
+        types.LEADER,
+    )
+    assert (oracle.REQ_NONE, oracle.REQ_VOTE, oracle.REQ_APPEND) == (
+        types.REQ_NONE,
+        types.REQ_VOTE,
+        types.REQ_APPEND,
+    )
+    assert (oracle.RESP_NONE, oracle.RESP_VOTE, oracle.RESP_APPEND) == (
+        types.RESP_NONE,
+        types.RESP_VOTE,
+        types.RESP_APPEND,
+    )
+    assert oracle.NIL == types.NIL

@@ -72,8 +72,9 @@ def step(cfg, s, inp=None):
     return _jitted_step(cfg)(s, inp if inp is not None else quiet_inputs(cfg))
 
 
-# Wire-format v8 helpers (Mailbox docstring): requests are per-sender broadcasts,
-# responses are packed [receiver, responder] words + a per-responder term.
+# Wire-format v9 helpers (Mailbox docstring): requests are per-sender broadcasts,
+# responses are a [receiver, responder] type plane + per-responder payloads
+# (grant target, ack target, success match, nack hint, term).
 
 
 def rv_wire(s, src, term, last_idx=0, last_term=0):
@@ -88,27 +89,41 @@ def rv_wire(s, src, term, last_idx=0, last_term=0):
 
 
 def resp_wire(s, q, r, rtype, term, ok, match=0):
-    """Wire a response from responder `r` to requester `q`."""
-    word = raft_types.pack_resp(
-        jnp.int32(rtype), jnp.int32(int(ok)), jnp.int32(match)
-    )
+    """Wire a response from responder `r` to requester `q`. An ok response names
+    `q` as r's one grant/ack target; `match` lands in the success-match field for
+    an ok append and in the nack-hint field otherwise."""
     mb = s.mailbox._replace(
-        resp_word=s.mailbox.resp_word.at[q, r].set(word),
+        resp_kind=s.mailbox.resp_kind.at[q, r].set(rtype),
         resp_term=s.mailbox.resp_term.at[r].set(term),
     )
+    if rtype == RESP_VOTE and ok:
+        mb = mb._replace(v_to=mb.v_to.at[r].set(q))
+    if rtype == RESP_APPEND:
+        if ok:
+            mb = mb._replace(
+                a_ok_to=mb.a_ok_to.at[r].set(q),
+                a_match=mb.a_match.at[r].set(match),
+            )
+        else:
+            mb = mb._replace(a_hint=mb.a_hint.at[r].set(match))
     return s._replace(mailbox=mb)
 
 
 def resp_type_of(mb, q, r):
-    return int(raft_types.unpack_resp(mb.resp_word[q, r])[0])
+    return int(mb.resp_kind[q, r])
 
 
 def resp_ok_of(mb, q, r):
-    return bool(int(raft_types.unpack_resp(mb.resp_word[q, r])[1]))
+    kind = int(mb.resp_kind[q, r])
+    if kind == RESP_VOTE:
+        return int(mb.v_to[r]) == q
+    if kind == RESP_APPEND:
+        return int(mb.a_ok_to[r]) == q
+    return False
 
 
 def resp_match_of(mb, q, r):
-    return int(raft_types.unpack_resp(mb.resp_word[q, r])[2])
+    return int(mb.a_match[r] if resp_ok_of(mb, q, r) else mb.a_hint[r])
 
 
 # ---------------------------------------------------------------- RequestVote handling
