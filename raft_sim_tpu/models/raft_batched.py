@@ -287,9 +287,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     win = (role == CANDIDATE) & (n_votes >= cfg.quorum) & inp.alive
     role = jnp.where(win, LEADER, role)
     leader_id = jnp.where(win, ids2, leader_id)
-    # Log indices fit int16 (config caps log_capacity); keeping the [N, N, B]
-    # bookkeeping planes and their intermediates at 2 bytes halves their HBM cost.
-    # Compaction carries absolute indices: int32 (types.index_dtype).
+    # Log indices are capacity-bounded (config caps log_capacity): the [N, N, B]
+    # bookkeeping planes and their intermediates ride int8/int16, cutting their
+    # HBM cost 4x/2x vs int32. Compaction carries absolute indices: int32
+    # (types.index_dtype).
     len_i = log_len.astype(s.next_index.dtype)
     next_index = jnp.where(win[:, None, :], (len_i + 1)[:, None, :], s.next_index)
     match_index = jnp.where(win[:, None, :], 0, s.match_index)
@@ -332,7 +333,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     if cap < n and not comp:
         # Thresholds 1..CAP only bound match values when indices are capacity-
         # bounded; compaction's absolute indices use the value-threshold form.
-        vth = (iota((1, 1, cap, 1), 2) + 1).astype(jnp.int16)  # thresholds 1..CAP
+        vth = (iota((1, 1, cap, 1), 2) + 1).astype(match_with_self.dtype)  # 1..CAP
         cnt_ge = jnp.sum(match_with_self[:, :, None, :] >= vth, axis=1)  # [N, CAP, B]
         quorum_match = jnp.sum(cnt_ge >= cfg.quorum, axis=1).astype(jnp.int32)  # [N, B]
     else:
@@ -487,16 +488,18 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         ws_all = jnp.min(jnp.where(eye3, big, prev_out), axis=1)
         ws = jnp.where(ws_resp == big, ws_all, ws_resp)
     else:
-        # Single [N, N, B] min instead of two: unresponsive peers ride +8192 and
-        # self +16384, so the min is the responsive minimum when one exists, else
-        # 8192 + the all-peers minimum (self cannot win it: 16384 > 8192 + CAP,
-        # CAP <= 4095; int16-safe: 16384 + 4095 < 32767). Same values as the
-        # two-pass form, one full reduction cheaper.
-        off = prev_out + jnp.where(
-            eye3, jnp.int16(2 << 13), jnp.where(responsive, jnp.int16(0), jnp.int16(1 << 13))
-        )
+        # Single [N, N, B] min instead of two: unresponsive peers ride +K and
+        # self +2K with K = cap + 1, so the min is the responsive minimum when
+        # one exists, else K + the all-peers minimum (self cannot win it:
+        # 2K > K + cap, and with n >= 2 some non-self edge is <= K + cap). The
+        # largest encoded value, 3*cap + 2, fits the index dtype by construction
+        # (types.MAX_INT8_LOG_CAPACITY / config.MAX_LOG_CAPACITY). Same values
+        # as the two-pass form, one full reduction cheaper.
+        K = jnp.asarray(cap + 1, len_i.dtype)
+        z = jnp.asarray(0, len_i.dtype)
+        off = prev_out + jnp.where(eye3, K + K, jnp.where(responsive, z, K))
         m = jnp.min(off, axis=1)  # [N, B]
-        ws = jnp.where(m >= (1 << 13), m - (1 << 13), m)
+        ws = jnp.where(m >= K, m - K, m)
     ws = jnp.minimum(ws, len_i)  # narrow dtype throughout; widened at header writes
     if comp:
         # The window cannot start below the compaction base; peers whose prev fell

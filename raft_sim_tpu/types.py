@@ -54,11 +54,25 @@ NIL = -1  # nil node id
 # commit gate (models/raft.py phase 6). Reserved: client commands may not use it.
 NOOP = -2
 
+# log_capacity ceiling for int8 index planes: the single-pass window-start min
+# (models/raft_batched.py phase 8) encodes self as +2K and unresponsive peers as
+# +K with K = cap + 1, so the largest encoded value is 2K + cap = 3*cap + 2,
+# which must fit the plane dtype. Asserted at import so widening a ceiling
+# without widening the dtype (or the encoding) is an immediate error, not a
+# silent negative-wrap in the window min.
+MAX_INT8_LOG_CAPACITY = 41
+assert 3 * MAX_INT8_LOG_CAPACITY + 2 <= 127  # int8 tier
+assert 3 * MAX_LOG_CAPACITY + 2 <= 32767  # int16 tier (utils/config.py ceiling)
+
+
 def index_dtype(cfg: RaftConfig):
-    """Dtype of the per-edge log-index planes (next/match). int16 when indices are
-    bounded by log_capacity <= 4095; int32 when compaction makes indices absolute
-    and unbounded."""
-    return jnp.int32 if cfg.compaction else jnp.int16
+    """Dtype of the per-edge log-index planes (next/match) and the per-responder
+    match/hint wire fields. Log indices are bounded by log_capacity without
+    compaction -- int8 up to capacity 41, int16 up to 4095 -- and absolute
+    (unbounded) with it: int32."""
+    if cfg.compaction:
+        return jnp.int32
+    return jnp.int8 if cfg.log_capacity <= MAX_INT8_LOG_CAPACITY else jnp.int16
 
 
 class Mailbox(NamedTuple):
@@ -156,11 +170,12 @@ class ClusterState(NamedTuple):
     leader_id: jax.Array  # [N] int32 (NIL = unknown)
     votes: jax.Array  # [N, N] bool; votes[i, j] = i holds a granted vote from j
     # The three [N, N] leader-bookkeeping planes are the largest state after the
-    # mailbox; log indices fit int16 (config asserts log_capacity <= 4095) and ages
-    # saturate (ACK_AGE_SAT), halving their HBM traffic vs int32. Compaction
-    # configs carry absolute (unbounded) indices: int32 (index_dtype).
-    next_index: jax.Array  # [N, N] int16/int32; leader i's next index for peer j
-    match_index: jax.Array  # [N, N] int16/int32
+    # mailbox; log indices are capacity-bounded (int8 up to capacity 41, int16 up
+    # to 4095 -- index_dtype) and ages saturate (ACK_AGE_SAT), cutting their HBM
+    # traffic vs int32. Compaction configs carry absolute (unbounded) indices:
+    # int32.
+    next_index: jax.Array  # [N, N] index_dtype; leader i's next index for peer j
+    match_index: jax.Array  # [N, N] index_dtype
     # Ticks since leader i last received an AppendEntries response (success OR
     # failure -- both prove the peer is up) from peer j, saturating at ACK_AGE_SAT;
     # zeroed for the whole row when i wins an election (grace period). Volatile

@@ -45,7 +45,9 @@ from raft_sim_tpu.utils.config import RaftConfig
 # v12: mailbox wire format v9 -- the packed per-edge response word became an int8
 #      resp_kind plane + per-responder payloads (v_to/a_ok_to/a_match/a_hint),
 #      removing the packed word's 2^28 committed-entry bound.
-_FORMAT_VERSION = 12
+# v13: int8 index planes (next/match and the match/hint wire fields) for
+#      non-compaction configs with log_capacity <= 41.
+_FORMAT_VERSION = 13
 
 
 def _normalize(path: str) -> str:

@@ -24,10 +24,11 @@ import dataclasses
 ACK_AGE_SAT = 30000
 
 # Upper bound on RaftConfig.log_capacity. Log indices ride int16 state planes
-# (ClusterState.next_index/match_index), and the single-pass window-start min
-# (models/raft_batched.py phase 8) encodes its responsiveness fallback in the
-# int16 headroom above the largest index: it needs 16384 + MAX_LOG_CAPACITY to
-# stay below int16 max, which 4095 does with room to spare.
+# at most (ClusterState.next_index/match_index; int8 below
+# types.MAX_INT8_LOG_CAPACITY), and the single-pass window-start min
+# (models/raft_batched.py phase 8) encodes its responsiveness fallback with
+# K = cap + 1 offsets, so its largest encoded value 3 * cap + 2 must fit the
+# plane dtype -- asserted at import in types.py next to the int8 ceiling.
 MAX_LOG_CAPACITY = 4095
 
 
@@ -99,8 +100,8 @@ class RaftConfig:
     # (log_base, base_term, base_chk); leaders whose peer's next_index falls below
     # their base send an InstallSnapshot analogue instead of entries
     # (models/raft.py phase 3/8). Compaction configs carry absolute indices, so
-    # the int16 next/match planes and the 12-bit packed response match widen to
-    # int32 (types.index_dtype).
+    # the capacity-bounded next/match planes and the match/hint wire fields
+    # widen to int32 (types.index_dtype).
     compact_margin: int = 0
 
     # Client command injection (reference: external curl POST /client-set,

@@ -298,7 +298,7 @@ def make_leader(s, node, term):
         term=s.term.at[node].set(term),
         leader_id=jnp.full((n,), node, jnp.int32),
         next_index=s.next_index.at[node].set(
-            jnp.full((n,), int(s.log_len[node]) + 1, jnp.int16)
+            jnp.full((n,), int(s.log_len[node]) + 1, s.next_index.dtype)
         ),
     )
 
@@ -409,7 +409,7 @@ def test_leader_does_not_commit_older_term_entries():
     leader is at term 3 -> no commit even with full match."""
     s = with_log(base_state(), 0, [1, 1])
     s = make_leader(s, 0, 3)
-    s = s._replace(match_index=s.match_index.at[0].set(jnp.full((5,), 2, jnp.int16)))
+    s = s._replace(match_index=s.match_index.at[0].set(jnp.full((5,), 2, s.match_index.dtype)))
     s2, _ = step(CFG, s)
     assert int(s2.commit_index[0]) == 0
 
@@ -437,7 +437,7 @@ def test_leader_heartbeats_on_timer():
     # Peers haven't acked entry 1 yet: nextIndex = 1 -> the heartbeat ships it.
     s = s._replace(
         deadline=s.deadline.at[0].set(1),
-        next_index=s.next_index.at[0].set(jnp.ones((5,), jnp.int16)),
+        next_index=s.next_index.at[0].set(jnp.ones((5,), s.next_index.dtype)),
     )
     s2, _ = step(CFG, s)
     assert int(s2.mailbox.req_type[0]) == REQ_APPEND
@@ -480,7 +480,7 @@ def test_restart_wipes_volatile_keeps_persistent():
     s = s._replace(
         voted_for=s.voted_for.at[0].set(0),
         votes=s.votes.at[0].set(jnp.ones((5,), bool)),
-        match_index=s.match_index.at[0].set(jnp.full((5,), 3, jnp.int16)),
+        match_index=s.match_index.at[0].set(jnp.full((5,), 3, s.match_index.dtype)),
         commit_index=s.commit_index.at[0].set(3),
     )
     s = raft_types.with_commit_chk(s)  # hand-set commit needs a matching checksum
@@ -590,7 +590,7 @@ def test_window_fallback_when_no_peer_responsive():
     s = s._replace(
         deadline=s.deadline.at[0].set(1),  # heartbeat due now
         # Peer 1 is far behind (next=1 -> prev=0); everyone stale beyond the window.
-        next_index=s.next_index.at[0, 1].set(jnp.int16(1)),
+        next_index=s.next_index.at[0, 1].set(1),
         ack_age=s.ack_age.at[0].set(
             jnp.full((5,), CFG.ack_timeout_ticks + 5, jnp.int16)
         ),
@@ -615,7 +615,7 @@ def test_stale_peer_excluded_from_window_start():
         deadline=s.deadline.at[0].set(1),
         # Stale peer 1 is far behind; responsive peers 2-4 are at prev=2.
         next_index=s.next_index.at[0].set(
-            jnp.asarray([4, 1, 3, 3, 3], jnp.int16)
+            jnp.asarray([4, 1, 3, 3, 3], s.next_index.dtype)
         ),
         ack_age=s.ack_age.at[0].set(ages),
     )
