@@ -3,11 +3,16 @@
 Prints ONE JSON line. The headline fields {"metric", "value", "unit", "vs_baseline"}
 are the north-star workload (config3: 100k x 5-node clusters, randomized election
 timeouts; target >=1M cluster-ticks/sec/chip, BASELINE.json `north_star`); the
-"matrix" field carries one row per BASELINE config (all five: config1 is the
+"matrix" field carries one row per BASELINE config (config1 is the
 single-cluster 10k-tick correctness reference with log matching checked every
-tick, config2 the 1k-cluster vmap row, 3-5 the throughput/fault rows) with
-throughput AND the quality metrics (p50 ticks-to-stable-leader, p50 offer->commit
-latency, accepted-command and safety-violation counts). The reference publishes no
+tick, config2 the 1k-cluster vmap row, 3-5 the throughput/fault rows -- config5
+now with sampled log matching on) plus three feature rows: config6 (ring
+compaction under crash churn), config6r (the same through the 302-redirect
+client write path), and config4c (config4's fault mix under client traffic, so
+commit latency is measured UNDER faults). Each row carries throughput AND the
+quality metrics (p50 ticks-to-stable-leader, mean-based p50 offer->commit
+latency, true per-entry lat_p50/p95/p99 from the on-device histogram,
+accepted-command / violation / liveness counters). The reference publishes no
 numbers of its own (SURVEY.md section 6).
 
 Two timing traps on this machine's TPU stack, both defended here:
@@ -43,16 +48,30 @@ NORTH_STAR = 1_000_000.0  # cluster-ticks/sec/chip, BASELINE.json north_star
 # config -> ticks per timed call (bounded so one call stays watchdog-safe even at
 # full batch; config5's N=51 tick is ~100x a 5-node tick). config1 runs its full
 # BASELINE 10k-tick soak (single cluster -- the correctness row, not a
-# throughput row).
+# throughput row). Rows 6/6r exercise the ring-compaction + redirect write
+# path, row 4c the config4 fault mix under client traffic, so the standing
+# bench carries compaction/redirect throughput and commit latency UNDER faults
+# (not only on reliable nets).
 MATRIX_TICKS = {
     "config1": 10_000,
     "config2": 2_000,
     "config3": 500,
     "config4": 300,
+    "config4c": 300,
     "config5": 200,
+    "config6": 5_000,
+    "config6r": 5_000,
 }
-SMOKE_BATCH = {"config2": 64, "config3": 512, "config4": 256, "config5": 16}
-SMOKE_TICKS = {"config1": 1_000}
+SMOKE_BATCH = {
+    "config2": 64,
+    "config3": 512,
+    "config4": 256,
+    "config4c": 256,
+    "config5": 16,
+    "config6": 64,
+    "config6r": 64,
+}
+SMOKE_TICKS = {"config1": 1_000, "config6": 1_000, "config6r": 1_000}
 
 
 def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
@@ -92,8 +111,13 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
         "p50_stable_tick": s.p50_stable_tick,
         "pct_stable": round(100.0 * s.n_stable / s.n_clusters, 1),
         "p50_commit_latency": s.p50_commit_latency,
+        "lat_p50": s.lat_p50,
+        "lat_p95": s.lat_p95,
+        "lat_p99": s.lat_p99,
         "total_cmds": s.total_cmds,
         "violations": s.total_violations,
+        "noop_blocked": s.noop_blocked,
+        "lm_skipped_pairs": s.lm_skipped_pairs,
         "quality_seeds": quality_seeds,
     }
 
@@ -112,7 +136,16 @@ def main() -> None:
     names = (
         [args.preset]
         if args.preset
-        else ["config1", "config2", "config3", "config4", "config5"]
+        else [
+            "config1",
+            "config2",
+            "config3",
+            "config4",
+            "config4c",
+            "config5",
+            "config6",
+            "config6r",
+        ]
     )
     matrix = {}
     for name in names:

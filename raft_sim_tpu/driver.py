@@ -234,8 +234,10 @@ def _offer_tick(cfg: RaftConfig, state, keys, metrics, value):
     from raft_sim_tpu.models import raft_batched
 
     s_t = raft_batched.to_batch_minor(state)
+    m_t = raft_batched.to_batch_minor(metrics)  # histogram leaf is [BINS, B] inside
     before = metrics.total_cmds
-    s2, metrics = scan.tick_batch_minor(cfg, s_t, keys, metrics, client_cmd=value)
+    s2, m2 = scan.tick_batch_minor(cfg, s_t, keys, m_t, client_cmd=value)
+    metrics = raft_batched.from_batch_minor(m2)
     return raft_batched.from_batch_minor(s2), metrics, metrics.total_cmds - before
 
 

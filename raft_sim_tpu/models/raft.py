@@ -59,6 +59,7 @@ from raft_sim_tpu.types import (
     ACK_AGE_SAT,
     CANDIDATE,
     FOLLOWER,
+    LAT_HIST_BINS,
     LEADER,
     NIL,
     NOOP,
@@ -406,25 +407,41 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     if cfg.client_interval > 0:
         sl = jnp.arange(cap, dtype=jnp.int32)[None, :]
         abs1 = (base[:, None] + (sl - base[:, None]) % cap + 1) if comp else (sl + 1)
-        # Dedup across leader changes: a freshly elected leader's own commit
-        # trails the cluster's prior frontier and would re-count entries its
-        # predecessor already reported, so only entries above the cluster-wide
-        # old frontier contribute. Only plausibly tick-encoded values count
-        # (offer ticks lie in (0, now)): manual Session.offer payloads outside
-        # that range are excluded instead of decoding as garbage latencies.
-        frontier = jnp.maximum(s.commit_index, jnp.max(s.commit_index))
-        newly = (abs1 > frontier[:, None]) & (abs1 <= commit[:, None])
+        # Dedup across leader changes AND restarts: a freshly elected leader's
+        # own commit trails the cluster's prior frontier and would re-count
+        # entries its predecessor already reported, so only entries above the
+        # CARRIED monotone frontier contribute (the per-node commit vector is
+        # restart-mutable -- ClusterState.lat_frontier). Only plausibly
+        # tick-encoded values count (offer ticks lie in (0, now)): manual
+        # Session.offer payloads outside that range are excluded instead of
+        # decoding as garbage latencies.
+        newly = (abs1 > s.lat_frontier) & (abs1 <= commit[:, None])
         lm = (
             (is_leader & inp.alive)[:, None]
             & newly
             & (log_val_arr >= 1)
             & (log_val_arr <= s.now)
         )
-        lat_sum = jnp.sum(jnp.where(lm, s.now - log_val_arr + 1, 0)).astype(jnp.int32)
+        lats = jnp.where(lm, s.now - log_val_arr + 1, 0)  # [N, CAP]
+        lat_sum = jnp.sum(lats).astype(jnp.int32)
         lat_cnt = jnp.sum(lm).astype(jnp.int32)
+        # Histogram bin = floor(log2(l)), clamped to the last bin: bit length
+        # via an unrolled binary reduction (no float log in the hot loop).
+        bl = jnp.zeros_like(lats)
+        v = lats
+        for sft in (16, 8, 4, 2, 1):
+            m_ = v >= (1 << sft)
+            bl = bl + m_ * sft
+            v = jnp.where(m_, v >> sft, v)
+        bin_ = jnp.minimum(bl, LAT_HIST_BINS - 1)
+        oh_b = (jnp.arange(LAT_HIST_BINS)[None, None, :] == bin_[:, :, None]) & lm[:, :, None]
+        lat_hist = jnp.sum(oh_b, axis=(0, 1)).astype(jnp.int32)  # [BINS]
+        lat_frontier = jnp.maximum(s.lat_frontier, jnp.max(commit))
     else:
         lat_sum = jnp.int32(0)
         lat_cnt = jnp.int32(0)
+        lat_hist = jnp.zeros((LAT_HIST_BINS,), jnp.int32)
+        lat_frontier = s.lat_frontier
 
     # ---- phase 5.5: log compaction -------------------------------------------------
     # The reference's unbounded log vector (log.clj:33) needs none; the ring must
@@ -486,9 +503,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         reserve = max(1, cfg.compact_margin // 2)
         noop = win & (log_len - base < cap)
         room = log_len - base < cap - reserve
+        # A win with NO room for its no-op: beyond the reserve's guarantee, the
+        # latent 5.4.2 commit-freeze the no-op exists to break -- surfaced as a
+        # liveness metric instead of stalling silently (StepInfo.noop_blocked).
+        noop_blocked = jnp.sum(win & ~(log_len - base < cap)).astype(jnp.int32)
     else:
         noop = jnp.zeros((n,), bool)
         room = log_len - base < cap
+        noop_blocked = jnp.int32(0)
     if cfg.client_redirect:
         # One command in flight: the pending redirected command, else a fresh
         # offer (dropped while the client is busy).
@@ -672,13 +694,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         deadline=deadline,
         client_pend=client_pend,
         client_dst=client_dst,
+        lat_frontier=lat_frontier,
         now=s.now + 1,
         mailbox=new_mb,
     )
 
     info = _step_info(
         cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok,
-        lat_sum, lat_cnt,
+        lat_sum, lat_cnt, lat_hist, noop_blocked,
     )
     return new_state, info
 
@@ -694,6 +717,8 @@ def _step_info(
     chk_ok: jax.Array,
     lat_sum: jax.Array,
     lat_cnt: jax.Array,
+    lat_hist: jax.Array,
+    noop_blocked: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
     n = cfg.n_nodes
@@ -734,24 +759,28 @@ def _step_info(
         viol_commit = f
 
     if cfg.check_log_matching:
-        # Log matching on committed prefixes: any two nodes agree on every entry
-        # (term AND value) up to m = min(commit_i, commit_j). O(N^2 * CAP) -- gated.
-        minc = jnp.minimum(new.commit_index[:, None], new.commit_index[None, :])
-        differ = (new.log_term[:, None, :] != new.log_term[None, :, :]) | (
-            new.log_val[:, None, :] != new.log_val[None, :, :]
-        )
-        if not cfg.compaction:
-            ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
-            both = ks[None, None, :] < minc[:, :, None]
-            viol_match = jnp.any(both & differ)
-        else:
+
+        def _check(_):
+            # Log matching on committed prefixes: any two nodes agree on every
+            # entry (term AND value) up to m = min(commit_i, commit_j).
+            # O(N^2 * CAP) -- gated, and sampled every log_matching_interval
+            # ticks (below).
+            minc = jnp.minimum(new.commit_index[:, None], new.commit_index[None, :])
+            differ = (new.log_term[:, None, :] != new.log_term[None, :, :]) | (
+                new.log_val[:, None, :] != new.log_val[None, :, :]
+            )
+            if not cfg.compaction:
+                ks = jnp.arange(cfg.log_capacity, dtype=jnp.int32)
+                both = ks[None, None, :] < minc[:, :, None]
+                return jnp.any(both & differ), jnp.int32(0)
             # Ring form, in two parts per pair (i, j) with mb = max(base_i, base_j):
             # entries in (mb, m] are live in BOTH rings at the same slot (same
             # absolute index, same CAP) -> compare slots; the prefix up to mb is
             # compared via checksums-at-mb (chk_at(i, p) = base_chk_i + live sum
             # (base_i, p]), which is computable because mb >= base_i. Pairs where
             # one node compacted past the other's commit (m < mb) are skipped --
-            # their agreement is pinned transitively through common peers.
+            # their agreement is pinned transitively through common peers -- and
+            # COUNTED (StepInfo.lm_skipped_pairs) so the coverage is measured.
             cap_ = cfg.log_capacity
             sl = jnp.arange(cap_, dtype=jnp.int32)[None, :]
             b = new.log_base
@@ -776,9 +805,24 @@ def _step_info(
                 dtype=jnp.uint32,
             )  # [N(i), N(j)] = chk of node i's prefix at mb(i, j)
             viol_prefix = jnp.any(comparable & (chk_at_mb != chk_at_mb.T))
-            viol_match = viol_suffix | viol_prefix
+            skipped = (jnp.sum(~comparable & ~eye) // 2).astype(jnp.int32)
+            return viol_suffix | viol_prefix, skipped
+
+        if cfg.log_matching_interval == 1:
+            viol_match, lm_skipped = _check(None)
+        else:
+            # Sampled cadence: the batch ticks in lockstep (config.py), so the
+            # predicate is one scalar in the batch-minor hot path and lax.cond
+            # truly skips the check off-cadence; under vmap (debug tier) cond
+            # lowers to a select and both branches run -- same values either way.
+            viol_match, lm_skipped = jax.lax.cond(
+                new.now % cfg.log_matching_interval == 0,
+                _check,
+                lambda _: (f, jnp.int32(0)),
+                None,
+            )
     else:
-        viol_match = f
+        viol_match, lm_skipped = f, jnp.int32(0)
 
     leader = jnp.min(jnp.where(live_leader, jnp.arange(n, dtype=jnp.int32), n))
     return StepInfo(
@@ -797,4 +841,7 @@ def _step_info(
         cmds_injected=jnp.any(do_inject).astype(jnp.int32),
         lat_sum=lat_sum,
         lat_cnt=lat_cnt,
+        lat_hist=lat_hist,
+        noop_blocked=noop_blocked,
+        lm_skipped_pairs=lm_skipped,
     )

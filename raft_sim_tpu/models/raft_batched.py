@@ -24,6 +24,7 @@ from raft_sim_tpu.types import (
     ACK_AGE_SAT,
     CANDIDATE,
     FOLLOWER,
+    LAT_HIST_BINS,
     LEADER,
     NIL,
     NOOP,
@@ -359,24 +360,33 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             abs1 = base[:, None, :] + (sl - base[:, None, :]) % cap + 1
         else:
             abs1 = sl + 1
-        # Frontier dedup + tick-encoded value gate (raft.py).
-        frontier = jnp.maximum(
-            s.commit_index, jnp.max(s.commit_index, axis=0, keepdims=True)
-        )  # [N, B]
-        newly = (abs1 > frontier[:, None, :]) & (abs1 <= commit[:, None, :])
+        # Carried-frontier dedup + tick-encoded value gate (raft.py).
+        newly = (abs1 > s.lat_frontier[None, None, :]) & (abs1 <= commit[:, None, :])
         lm = (
             (is_leader & inp.alive)[:, None, :]
             & newly
             & (log_val_arr >= 1)
             & (log_val_arr <= s.now[None, None, :])
         )
-        lat_sum = jnp.sum(
-            jnp.where(lm, s.now[None, None, :] - log_val_arr + 1, 0), axis=(0, 1)
-        ).astype(jnp.int32)
+        lats = jnp.where(lm, s.now[None, None, :] - log_val_arr + 1, 0)  # [N, CAP, B]
+        lat_sum = jnp.sum(lats, axis=(0, 1)).astype(jnp.int32)
         lat_cnt = jnp.sum(lm, axis=(0, 1)).astype(jnp.int32)
+        # Histogram bin = floor(log2(l)) via unrolled bit-length (raft.py).
+        bl = jnp.zeros_like(lats)
+        v = lats
+        for sft in (16, 8, 4, 2, 1):
+            m_ = v >= (1 << sft)
+            bl = bl + m_ * sft
+            v = jnp.where(m_, v >> sft, v)
+        bin_ = jnp.minimum(bl, LAT_HIST_BINS - 1)
+        oh_b = (iota((1, 1, LAT_HIST_BINS, 1), 2) == bin_[:, :, None, :]) & lm[:, :, None, :]
+        lat_hist = jnp.sum(oh_b, axis=(0, 1)).astype(jnp.int32)  # [BINS, B]
+        lat_frontier = jnp.maximum(s.lat_frontier, jnp.max(commit, axis=0))
     else:
         lat_sum = jnp.zeros_like(s.now)
         lat_cnt = jnp.zeros_like(s.now)
+        lat_hist = jnp.zeros((LAT_HIST_BINS, b), jnp.int32)
+        lat_frontier = s.lat_frontier
 
     # ---- phase 5.5: log compaction (raft.py) -------------------------------------
     base_mid, bchk_mid = base, bchk  # post-install, pre-advance (checksum anchor)
@@ -411,9 +421,12 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         reserve = max(1, cfg.compact_margin // 2)
         noop = win & (log_len - base < cap)
         room = log_len - base < cap - reserve
+        # Win with no no-op room: surfaced as a liveness metric (raft.py).
+        noop_blocked = jnp.sum(win & ~(log_len - base < cap), axis=0).astype(jnp.int32)
     else:
         noop = jnp.zeros_like(is_leader)
         room = log_len - base < cap
+        noop_blocked = jnp.zeros_like(s.now)
     if cfg.client_redirect:
         have_pend = s.client_pend != NIL  # [B]
         fresh = (inp.client_cmd != NIL) & ~have_pend
@@ -595,13 +608,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         deadline=deadline,
         client_pend=client_pend,
         client_dst=client_dst,
+        lat_frontier=lat_frontier,
         now=s.now + 1,
         mailbox=new_mb,
     )
 
     info = _step_info_b(
         cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok,
-        lat_sum, lat_cnt,
+        lat_sum, lat_cnt, lat_hist, noop_blocked,
     )
     return new_state, info
 
@@ -617,6 +631,8 @@ def _step_info_b(
     chk_ok: jax.Array,
     lat_sum: jax.Array,
     lat_cnt: jax.Array,
+    lat_hist: jax.Array,
+    noop_blocked: jax.Array,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
@@ -650,17 +666,21 @@ def _step_info_b(
         viol_commit = f
 
     if cfg.check_log_matching:
-        minc = jnp.minimum(new.commit_index[:, None, :], new.commit_index[None, :, :])
-        differ = (new.log_term[:, None] != new.log_term[None, :]) | (
-            new.log_val[:, None] != new.log_val[None, :]
-        )  # [N, N, CAP, B]
-        if not cfg.compaction:
-            both = iota((1, 1, cfg.log_capacity, 1), 2) < minc[:, :, None, :]
-            viol_match = jnp.any(both & differ, axis=(0, 1, 2))
-        else:
+
+        def _check(_):
+            minc = jnp.minimum(
+                new.commit_index[:, None, :], new.commit_index[None, :, :]
+            )
+            differ = (new.log_term[:, None] != new.log_term[None, :]) | (
+                new.log_val[:, None] != new.log_val[None, :]
+            )  # [N, N, CAP, B]
+            if not cfg.compaction:
+                both = iota((1, 1, cfg.log_capacity, 1), 2) < minc[:, :, None, :]
+                return jnp.any(both & differ, axis=(0, 1, 2)), jnp.zeros_like(new.now)
             # Ring form (see raft._step_info): slots live in BOTH rings over
             # (max base, min commit] compare directly; the shared prefix below
-            # max(base_i, base_j) compares via checksums-at-mb.
+            # max(base_i, base_j) compares via checksums-at-mb; incomparable
+            # pairs are counted (lm_skipped_pairs).
             cap = cfg.log_capacity
             bb = new.log_base  # [N, B]
             sl = iota((1, cap, 1), 1)
@@ -693,9 +713,24 @@ def _step_info_b(
             viol_prefix = jnp.any(
                 comparable & (chk_at_mb != jnp.swapaxes(chk_at_mb, 0, 1)), axis=(0, 1)
             )
-            viol_match = viol_suffix | viol_prefix
+            skipped = (
+                jnp.sum(~comparable & ~eye3, axis=(0, 1)) // 2
+            ).astype(jnp.int32)
+            return viol_suffix | viol_prefix, skipped
+
+        if cfg.log_matching_interval == 1:
+            viol_match, lm_skipped = _check(None)
+        else:
+            # Lockstep cadence: now[0] is the whole batch's tick (config.py), a
+            # scalar pred, so lax.cond skips the check entirely off-cadence.
+            viol_match, lm_skipped = jax.lax.cond(
+                new.now.reshape(-1)[0] % cfg.log_matching_interval == 0,
+                _check,
+                lambda _: (f, jnp.zeros_like(new.now)),
+                None,
+            )
     else:
-        viol_match = f
+        viol_match, lm_skipped = f, jnp.zeros_like(new.now)
 
     leader = jnp.min(jnp.where(live_leader, iota((n, 1), 0), n), axis=0)  # [B]
     return StepInfo(
@@ -713,4 +748,7 @@ def _step_info_b(
         cmds_injected=jnp.any(do_inject, axis=0).astype(jnp.int32),  # offers, not leaders; see raft.py
         lat_sum=lat_sum,
         lat_cnt=lat_cnt,
+        lat_hist=lat_hist,
+        noop_blocked=noop_blocked,
+        lm_skipped_pairs=lm_skipped,
     )

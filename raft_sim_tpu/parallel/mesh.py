@@ -133,6 +133,18 @@ class FleetSummary(NamedTuple):
     # each live leader's commit advancement; None when no cluster committed any
     # client entry (e.g. client_interval == 0).
     p50_commit_latency: float | None
+    # TRUE per-entry latency percentiles, recovered from the fleet-summed
+    # log2-bin histogram (RunMetrics.lat_hist) with linear interpolation inside
+    # the hit bin -- the tail visibility the mean-of-means above lacks. None
+    # when no entry committed.
+    lat_p50: float | None
+    lat_p95: float | None
+    lat_p99: float | None
+    # Liveness/coverage counters (RunMetrics): election wins that found no
+    # no-op slot (compaction livelock early-warning), and node pairs the ring
+    # log-matching check could not compare.
+    noop_blocked: int
+    lm_skipped_pairs: int
 
 
 def gather_metrics(metrics):
@@ -155,6 +167,23 @@ def gather_metrics(metrics):
     return jax.device_get(jax.jit(lambda t: t, out_shardings=rep)(metrics))
 
 
+def _hist_percentile(hist, q: float) -> float | None:
+    """The q-quantile latency from a summed log2-bin histogram: bin k holds
+    latencies in [2^k, 2^(k+1)), linearly interpolated inside the hit bin.
+    None for an empty histogram."""
+    total = int(hist.sum())
+    if total == 0:
+        return None
+    need = q * total
+    cum = 0
+    for k, c in enumerate(int(x) for x in hist):
+        if c and cum + c >= need:
+            lo, hi = float(1 << k), float(1 << (k + 1))
+            return lo + (need - cum) / c * (hi - lo)
+        cum += c
+    return float(1 << len(hist))
+
+
 def summarize(metrics) -> FleetSummary:
     """Fleet-level rollup of a batched RunMetrics. The p50 quantile is computed
     host-side from the (small, [batch]-shaped) stable-tick vector. Handles
@@ -173,6 +202,7 @@ def summarize(metrics) -> FleetSummary:
         if np.any(committed)
         else None
     )
+    hist = np.sum(np.asarray(m.lat_hist, dtype=np.int64), axis=0)  # [BINS]
     return FleetSummary(
         n_clusters=int(m.ticks.shape[0]),
         total_violations=int(np.sum(m.violations)),
@@ -182,4 +212,9 @@ def summarize(metrics) -> FleetSummary:
         total_msgs=int(np.sum(m.total_msgs, dtype=np.int64)),
         total_cmds=int(np.sum(m.total_cmds, dtype=np.int64)),
         p50_commit_latency=p50_lat,
+        lat_p50=_hist_percentile(hist, 0.50),
+        lat_p95=_hist_percentile(hist, 0.95),
+        lat_p99=_hist_percentile(hist, 0.99),
+        noop_blocked=int(np.sum(m.noop_blocked, dtype=np.int64)),
+        lm_skipped_pairs=int(np.sum(m.lm_skipped_pairs, dtype=np.int64)),
     )

@@ -37,6 +37,9 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
         total_cmds=a.total_cmds + b.total_cmds,
         lat_sum=a.lat_sum + b.lat_sum,
         lat_cnt=a.lat_cnt + b.lat_cnt,
+        lat_hist=a.lat_hist + b.lat_hist,
+        noop_blocked=a.noop_blocked + b.noop_blocked,
+        lm_skipped_pairs=a.lm_skipped_pairs + b.lm_skipped_pairs,
         ticks=a.ticks + b.ticks,
     )
 

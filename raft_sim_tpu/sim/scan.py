@@ -55,6 +55,14 @@ class RunMetrics(NamedTuple):
     # rolls the fleet p50 of those means.
     lat_sum: jax.Array  # int32
     lat_cnt: jax.Array  # int32
+    # Per-entry latency histogram (log2 bins, StepInfo.lat_hist): summed over the
+    # fleet in parallel.summarize to recover true p50/p95/p99 percentiles. The
+    # one non-scalar metric leaf: [LAT_HIST_BINS] per cluster (public [B, BINS]
+    # layout; the batch-minor scan carries it [BINS, B] internally).
+    lat_hist: jax.Array  # [LAT_HIST_BINS] int32
+    # Liveness/coverage counters (StepInfo.noop_blocked / lm_skipped_pairs).
+    noop_blocked: jax.Array  # int32: election wins denied their no-op slot
+    lm_skipped_pairs: jax.Array  # int32: pair-checks skipped by ring log matching
     ticks: jax.Array  # int32
 
 
@@ -64,6 +72,8 @@ def init_metrics_batch(batch: int) -> RunMetrics:
 
 
 def init_metrics() -> RunMetrics:
+    from raft_sim_tpu.types import LAT_HIST_BINS
+
     z = jnp.int32(0)
     return RunMetrics(
         violations=z,
@@ -76,6 +86,9 @@ def init_metrics() -> RunMetrics:
         total_cmds=z,
         lat_sum=z,
         lat_cnt=z,
+        lat_hist=jnp.zeros((LAT_HIST_BINS,), jnp.int32),
+        noop_blocked=z,
+        lm_skipped_pairs=z,
         ticks=z,
     )
 
@@ -98,6 +111,9 @@ def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
         total_cmds=m.total_cmds + info.cmds_injected,
         lat_sum=m.lat_sum + info.lat_sum,
         lat_cnt=m.lat_cnt + info.lat_cnt,
+        lat_hist=m.lat_hist + info.lat_hist,
+        noop_blocked=m.noop_blocked + info.noop_blocked,
+        lm_skipped_pairs=m.lm_skipped_pairs + info.lm_skipped_pairs,
         ticks=m.ticks + 1,
     )
 
@@ -168,10 +184,18 @@ def run_batch_minor(
         s, m = carry
         return tick_batch_minor(cfg, s, keys, m, step_fn=step_fn), None
 
+    # Metrics ride the scan batch-minor too (the histogram leaf is [BINS, B]
+    # there; scalars-per-cluster are [B] in either layout).
     (final_t, metrics), _ = lax.scan(
-        body, (s_t, init_metrics_batch(batch)), None, length=n_ticks
+        body,
+        (s_t, raft_batched.to_batch_minor(init_metrics_batch(batch))),
+        None,
+        length=n_ticks,
     )
-    return raft_batched.from_batch_minor(final_t), metrics
+    return (
+        raft_batched.from_batch_minor(final_t),
+        raft_batched.from_batch_minor(metrics),
+    )
 
 
 def tick_batch_minor(cfg, s, keys, metrics, step_fn=None, client_cmd=None):

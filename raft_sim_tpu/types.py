@@ -49,6 +49,11 @@ RESP_VOTE = 1  # :vote-response
 RESP_APPEND = 2  # :append-response
 
 NIL = -1  # nil node id
+
+# Bins of the per-entry commit-latency histogram (StepInfo.lat_hist): bin k
+# holds latencies with floor(log2(l)) == k, so 16 bins cover 1 .. 2^16-1 ticks
+# with the last bin absorbing anything longer.
+LAT_HIST_BINS = 16
 # Log value of a leader no-op entry (compaction only): appended on election win so
 # a current-term entry exists to pull old-term entries through the spec-5.4.2
 # commit gate (models/raft.py phase 6). Reserved: client commands may not use it.
@@ -212,6 +217,14 @@ class ClusterState(NamedTuple):
     # (core.clj:151-160). Not node state: crash faults never touch it.
     client_pend: jax.Array  # scalar int32 command value in flight (NIL = none)
     client_dst: jax.Array  # scalar int32 node the pending command targets
+    # Monotone commit-latency frontier: the highest commit index any node of this
+    # cluster has ever reached. The latency metric counts an entry when the live
+    # leader's commit first passes it; dedup against this CARRIED maximum (not
+    # the restart-mutable per-node commit vector) so a restarted max-commit node
+    # regressing to its log_base cannot make a later leader re-count entries
+    # already reported (advisor finding, round 4). Measurement state, not node
+    # state: crash faults never touch it. Zero when client_interval == 0.
+    lat_frontier: jax.Array  # scalar int32
     now: jax.Array  # scalar int32 global tick counter
     mailbox: Mailbox
 
@@ -255,6 +268,23 @@ class StepInfo(NamedTuple):
     # cfg.client_interval > 0.
     lat_sum: jax.Array  # int32: sum of commit latencies of entries committed this tick
     lat_cnt: jax.Array  # int32: number of client entries committed this tick
+    # Per-entry latency histogram: bin k counts entries committed this tick whose
+    # latency l (in ticks, >= 1) has floor(log2(l)) == k, clamped to the last
+    # bin. Fixed log-spaced bins make true fleet p50/p95/p99 recoverable in
+    # summarize, where the old accumulators only supported a mean of means.
+    lat_hist: jax.Array  # [LAT_HIST_BINS] int32 (zeros unless client_interval > 0)
+    # Election wins that could NOT append their no-op because the ring held no
+    # free slot (compaction only). The no-op reserve guarantees room for
+    # max(1, compact_margin // 2) consecutive commit-free elections; a deeper
+    # commit-free chain would freeze commit permanently (the 5.4.2 deadlock the
+    # no-op exists to break), so any nonzero count here makes that latent
+    # livelock visible instead of silent (advisor finding, round 4).
+    noop_blocked: jax.Array  # int32: count of win & no-noop-room events this tick
+    # Node pairs the compaction-form log-matching check could not compare this
+    # tick (one node's base passed the other's commit; their agreement is pinned
+    # transitively and via checksums). Measures the ring check's coverage
+    # instead of assuming it. Zero unless check_log_matching ran this tick.
+    lm_skipped_pairs: jax.Array  # int32: unordered pairs skipped by the check
 
 
 def empty_mailbox(cfg: RaftConfig) -> Mailbox:
@@ -312,6 +342,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         deadline=deadline,
         client_pend=jnp.int32(NIL),
         client_dst=jnp.int32(0),
+        lat_frontier=jnp.int32(0),
         now=jnp.int32(0),
         mailbox=empty_mailbox(cfg),
     )

@@ -47,7 +47,10 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      removing the packed word's 2^28 committed-entry bound.
 # v13: int8 index planes (next/match and the match/hint wire fields) for
 #      non-compaction configs with log_capacity <= 41.
-_FORMAT_VERSION = 13
+# v14: metrics v2 -- ClusterState gained lat_frontier (monotone latency dedup
+#      frontier); RunMetrics gained lat_hist (per-entry log2-bin latency
+#      histogram), noop_blocked, and lm_skipped_pairs.
+_FORMAT_VERSION = 14
 
 
 def _normalize(path: str) -> str:

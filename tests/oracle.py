@@ -528,6 +528,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 rtype += RESP_APPEND
             out["resp_kind"][q, r] = rtype
 
+    # Monotone commit-latency frontier (types.ClusterState.lat_frontier):
+    # measurement state maintained only under client workloads, deduping the
+    # latency metric against the highest commit any node ever reached.
+    lat_frontier = int(s["lat_frontier"])
+    if cfg.client_interval > 0:
+        lat_frontier = max(lat_frontier, int(commit.max()))
+
     return {
         "role": role,
         "term": term,
@@ -549,6 +556,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "deadline": deadline,
         "client_pend": np.int32(client_pend),
         "client_dst": np.int32(client_dst),
+        "lat_frontier": np.int32(lat_frontier),
         "now": np.int32(int(s["now"]) + 1),
         "mailbox": out,
     }

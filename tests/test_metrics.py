@@ -1,0 +1,213 @@
+"""Metrics v2: the carried commit-latency frontier, the per-entry latency
+histogram, the no-op liveness counter, and log-matching sampling + skipped-pair
+coverage.
+
+The reference has no metrics at all beyond its println trace (core.clj:182-186);
+these measurement surfaces are north-star machinery, so their accuracy gets its
+own unit tier: the frontier tests pin the restart-regression dedup bug the
+round-4 advisor found, the histogram tests pin that true percentiles are
+recoverable, and the sampling tests pin that a real violation is still caught on
+check ticks.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_sim_tpu import CANDIDATE, LEADER, RaftConfig, types
+from raft_sim_tpu.parallel import summarize
+from raft_sim_tpu.parallel.mesh import _hist_percentile
+from raft_sim_tpu.sim import scan
+from tests.test_compaction import CFG as RING_CFG
+from tests.test_compaction import hist, with_ring_log
+from tests.test_handlers import base_state, make_leader, quiet_inputs, step, with_log
+
+
+# ------------------------------------------------------- commit-latency frontier
+
+CLIENT_CFG = RaftConfig(n_nodes=5, log_capacity=8, client_interval=8)
+
+
+def _committing_leader(node=0, frontier=0):
+    """Node `node` is a leader whose full-match quorum advances commit 0 -> 3 on
+    the next tick, over three tick-encoded client entries (values 100..102)."""
+    s = base_state(CLIENT_CFG)
+    s = with_log(s, node, [1, 1, 1])  # values 100 + slot
+    s = make_leader(s, node, 1)
+    s = s._replace(
+        match_index=s.match_index.at[node].set(
+            jnp.full((5,), 3, s.match_index.dtype)
+        ),
+        now=jnp.int32(200),  # values 100..102 lie in (0, now): tick-plausible
+        lat_frontier=jnp.int32(frontier),
+    )
+    return s
+
+
+def test_latency_counts_first_commit():
+    s2, info = step(CLIENT_CFG, _committing_leader(frontier=0))
+    assert int(s2.commit_index[0]) == 3
+    assert int(info.lat_cnt) == 3
+    # now=200, values 100..102 -> latencies 101, 100, 99 (now - value + 1)
+    assert int(info.lat_sum) == 300
+    # The frontier advances to the new commit maximum.
+    assert int(s2.lat_frontier) == 3
+
+
+def test_latency_frontier_blocks_recount():
+    """Entries below the carried frontier never re-count, even though this
+    leader's own commit advancement crosses them."""
+    s2, info = step(CLIENT_CFG, _committing_leader(frontier=3))
+    assert int(s2.commit_index[0]) == 3
+    assert int(info.lat_cnt) == 0
+    assert int(s2.lat_frontier) == 3
+
+
+def test_latency_frontier_survives_restart():
+    """The round-4 advisor finding: the old frontier was the max of the per-node
+    commit vector, which a restarting max-commit node REGRESSES (commit wipes to
+    log_base), so a leader re-advancing commit re-counted reported entries. The
+    carried frontier is monotone: a restart on the same tick as the re-advance
+    must contribute zero."""
+    s = _committing_leader(node=1, frontier=3)
+    # Node 0 held the cluster's old max commit (3) and restarts this tick.
+    s = with_log(s, 0, [1, 1, 1])
+    s = s._replace(commit_index=s.commit_index.at[0].set(3))
+    s = types.with_commit_chk(s)
+    inp = quiet_inputs(CLIENT_CFG)
+    inp = inp._replace(restarted=inp.restarted.at[0].set(True))
+    s2, info = step(CLIENT_CFG, s, inp)
+    assert int(s2.commit_index[0]) == 0  # restart wiped to log_base
+    assert int(s2.commit_index[1]) == 3  # leader re-advanced past old ground
+    assert int(info.lat_cnt) == 0  # ... but nothing re-counted
+    assert int(s2.lat_frontier) == 3
+
+
+# ------------------------------------------------------------ latency histogram
+
+
+def test_hist_percentile_interpolation():
+    h = np.zeros(16, np.int64)
+    h[2] = 10  # all latencies in [4, 8)
+    assert 4.0 <= _hist_percentile(h, 0.5) < 8.0
+    assert _hist_percentile(np.zeros(16, np.int64), 0.5) is None
+    h2 = np.zeros(16, np.int64)
+    h2[0], h2[3] = 1, 1
+    assert _hist_percentile(h2, 0.99) >= 8.0  # tail lands in the high bin
+    assert _hist_percentile(h2, 0.25) < 2.0
+
+
+def test_latency_histogram_matches_counts():
+    """Fleet histogram mass equals the latency count, and the recovered
+    percentiles bracket the known direct-mode latency (~3 ticks on a reliable
+    net: append on the offer tick, ship on the next heartbeat, ack commits)."""
+    cfg = RaftConfig(n_nodes=5, client_interval=8)
+    _, m = scan.simulate(cfg, 0, 64, 400)
+    md = jax.device_get(m)
+    assert md.lat_hist.shape == (64, types.LAT_HIST_BINS)
+    total = int(md.lat_cnt.sum())
+    assert total > 0
+    assert int(md.lat_hist.sum()) == total
+    s = summarize(m)
+    assert s.lat_p50 is not None and 2.0 <= s.lat_p50 <= 4.0
+    assert s.lat_p50 <= s.lat_p95 <= s.lat_p99
+
+
+def test_offer_tick_preserves_histogram_layout():
+    """Session.offer round-trips metrics through the batch-minor layout; the
+    histogram leaf must come back [B, BINS] and keep accumulating."""
+    from raft_sim_tpu.driver import Session
+
+    sess = Session(RaftConfig(n_nodes=5, client_interval=4), batch=4, seed=0)
+    sess.run(40)
+    r = sess.offer(-5, wait=8)
+    assert sess.metrics.lat_hist.shape == (4, types.LAT_HIST_BINS)
+    assert r["committed"] >= 1
+    s = sess.summary()
+    assert s["lat_p50"] is not None
+
+
+# ----------------------------------------------------------- no-op liveness gauge
+
+
+def test_noop_blocked_counted_when_ring_full():
+    """An election win over a ring FULL of uncommitted entries cannot append its
+    no-op: the latent 5.4.2 commit freeze must surface in the counter."""
+    cap = RING_CFG.log_capacity
+    s = base_state(RING_CFG)
+    s = with_ring_log(s, 0, base=0, entries=hist(0, cap), commit=0)
+    s = s._replace(
+        role=s.role.at[0].set(CANDIDATE),
+        term=s.term.at[0].set(2),
+        voted_for=s.voted_for.at[0].set(0),
+        votes=s.votes.at[0].set(jnp.ones((5,), bool)),
+    )
+    s2, info = step(RING_CFG, s)
+    assert int(s2.role[0]) == LEADER  # the win itself goes through
+    assert int(s2.log_len[0]) == cap  # ... but no no-op was appended
+    assert int(info.noop_blocked) == 1
+
+
+def test_noop_blocked_zero_with_room():
+    s = base_state(RING_CFG)
+    s = with_ring_log(s, 0, base=0, entries=hist(0, 3), commit=0)
+    s = s._replace(
+        role=s.role.at[0].set(CANDIDATE),
+        term=s.term.at[0].set(2),
+        voted_for=s.voted_for.at[0].set(0),
+        votes=s.votes.at[0].set(jnp.ones((5,), bool)),
+    )
+    s2, info = step(RING_CFG, s)
+    assert int(s2.role[0]) == LEADER
+    assert int(s2.log_len[0]) == 4  # no-op appended
+    assert int(info.noop_blocked) == 0
+
+
+# ------------------------------------- log-matching sampling + skipped-pair gauge
+
+
+def test_lm_skipped_pairs_counted():
+    """Pairs where one node compacted past the other's commit are skipped by the
+    ring check -- and now counted, so the check's coverage is measured."""
+    cfg = dataclasses.replace(RING_CFG, check_log_matching=True)
+    s = base_state(cfg)
+    # Node 0 compacted to base 6 with commit 8; every other node's commit is
+    # below 6, so all four (0, j) pairs are incomparable.
+    s = with_ring_log(s, 0, base=6, entries=hist(6, 8), commit=8)
+    s = with_ring_log(s, 1, base=0, entries=hist(0, 2), commit=2)
+    s2, info = step(cfg, s)
+    assert int(info.lm_skipped_pairs) == 4
+    assert not bool(info.viol_log_matching)
+    assert not bool(info.viol_commit)
+
+
+def _mismatched_committed_logs(cfg):
+    """Nodes 0 and 1 disagree on their one committed entry -- a genuine
+    log-matching violation."""
+    s = base_state(cfg)
+    s = with_log(s, 0, [1])
+    s = with_log(s, 1, [1])
+    s = s._replace(
+        log_val=s.log_val.at[1, 0].set(999),
+        commit_index=s.commit_index.at[0].set(1).at[1].set(1),
+    )
+    return types.with_commit_chk(s)
+
+
+def test_log_matching_interval_samples_on_cadence():
+    cfg = RaftConfig(
+        n_nodes=5, log_capacity=8, check_log_matching=True, log_matching_interval=4
+    )
+    s = _mismatched_committed_logs(cfg)
+    # new.now = 2: off-cadence -> the (real) violation goes unobserved this tick.
+    _, info = step(cfg, s._replace(now=jnp.int32(1)))
+    assert not bool(info.viol_log_matching)
+    # new.now = 4: check tick -> caught.
+    _, info = step(cfg, s._replace(now=jnp.int32(3)))
+    assert bool(info.viol_log_matching)
+    # Interval 1 (the default) checks every tick.
+    cfg1 = dataclasses.replace(cfg, log_matching_interval=1)
+    _, info = step(cfg1, s._replace(now=jnp.int32(1)))
+    assert bool(info.viol_log_matching)
