@@ -512,34 +512,52 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         room = log_len - base < cap
         noop_blocked = jnp.int32(0)
     if cfg.client_redirect:
-        # One command in flight: the pending redirected command, else a fresh
-        # offer (dropped while the client is busy).
-        have_pend = s.client_pend != NIL
-        fresh = (inp.client_cmd != NIL) & ~have_pend
-        cmd = jnp.where(have_pend, s.client_pend, inp.client_cmd)
-        tgt = jnp.where(have_pend, s.client_dst, inp.client_target)
-        active = have_pend | fresh
-        tgt_oh = ids == tgt
-        client_ok = active & tgt_oh & is_leader & inp.alive & room & ~noop
-        accepted = jnp.any(client_ok)
-        # Redirect the client: to the target's known leader when the target is up
-        # and knows one, else to a random peer (core.clj:152-155). A rejected
-        # POST at a full leader retries there next tick.
-        tgt_ld = jnp.max(jnp.where(tgt_oh, leader_id, NIL))
-        tgt_up = jnp.any(tgt_oh & inp.alive)
-        pend_on = active & ~accepted
-        client_pend = jnp.where(pend_on, cmd, NIL)
+        # K commands in flight (cfg.client_pipeline -- the reference's
+        # buffered(5) request channel, server.clj:37): a fresh offer takes the
+        # FIRST free slot (dropped only when all K are busy); each active slot
+        # independently chases redirects. Per node, at most ONE slot is
+        # accepted per tick -- the reference's loop dequeues one message per
+        # wait iteration -- lowest slot index first; slots targeting distinct
+        # leaders (split-brain windows) can accept in parallel.
+        kdim = cfg.client_pipeline
+        kk = jnp.arange(kdim, dtype=jnp.int32)
+        free = s.client_pend == NIL  # [K]
+        first_free = free & (jnp.cumsum(free) == 1)
+        fresh = (inp.client_cmd != NIL) & first_free
+        pend = jnp.where(fresh, inp.client_cmd, s.client_pend)  # [K]
+        tgt = jnp.where(fresh, inp.client_target, s.client_dst)
+        active = pend != NIL
+        tgt_oh = active[:, None] & (tgt[:, None] == ids[None, :])  # [K, N]
+        low_k = jnp.min(jnp.where(tgt_oh, kk[:, None], kdim), axis=0)  # [N]
+        node_ok = is_leader & inp.alive & room & ~noop
+        client_ok = (low_k < kdim) & node_ok  # [N] nodes accepting a slot
+        sel_k = tgt_oh & (kk[:, None] == low_k[None, :]) & node_ok[None, :]  # [K, N]
+        wval_cl = jnp.sum(jnp.where(sel_k, pend[:, None], 0), axis=0)  # [N]
+        accepted_k = jnp.any(sel_k, axis=1)  # [K]
+        # Distinct slots hold distinct offers: the count is exact (the direct
+        # client's any() collapses split-brain double-accepts of ONE offer).
+        cmds_cnt = jnp.sum(accepted_k).astype(jnp.int32)
+        # Redirect still-pending slots: to the target's known leader when the
+        # target is up and knows one, else to a random peer (core.clj:152-155).
+        # A rejected POST at a full leader retries there next tick.
+        tgt_ld = jnp.max(jnp.where(tgt_oh, leader_id[None, :], NIL), axis=1)  # [K]
+        tgt_up = jnp.any(tgt_oh & inp.alive[None, :], axis=1)
+        pend_on = active & ~accepted_k
+        client_pend = jnp.where(pend_on, pend, NIL)
         client_dst = jnp.where(
             pend_on, jnp.where(tgt_up & (tgt_ld != NIL), tgt_ld, inp.client_bounce), 0
         )
     else:
         client_ok = (inp.client_cmd != NIL) & is_leader & inp.alive & room & ~noop
-        cmd = inp.client_cmd
+        wval_cl = jnp.broadcast_to(inp.client_cmd, (n,))
+        # any(), not sum(): during a split-brain window two live leaders can
+        # both accept the same offered command; that is ONE offer accepted, and
+        # the offered-vs-committed audit counts offers.
+        cmds_cnt = jnp.any(client_ok).astype(jnp.int32)
         client_pend = s.client_pend
         client_dst = s.client_dst
     do_write = noop | client_ok
-    do_inject = client_ok  # metrics count client accepts only, not leader no-ops
-    wval = jnp.where(noop, NOOP, cmd)
+    wval = jnp.where(noop, NOOP, wval_cl)
     inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)
     log_term_arr = log_term_arr.at[ids, inj_pos].set(term, mode="drop")
     log_val_arr = log_val_arr.at[ids, inj_pos].set(
@@ -700,7 +718,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     )
 
     info = _step_info(
-        cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok,
+        cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, noop_blocked,
     )
     return new_state, info
@@ -713,7 +731,7 @@ def _step_info(
     req_in: jax.Array,
     resp_in: jax.Array,
     alive: jax.Array,
-    do_inject: jax.Array,
+    cmds_cnt: jax.Array,
     chk_ok: jax.Array,
     lat_sum: jax.Array,
     lat_cnt: jax.Array,
@@ -835,10 +853,10 @@ def _step_info(
         max_commit=jnp.max(new.commit_index),
         min_commit=jnp.min(new.commit_index),
         msgs_delivered=(jnp.sum(req_in) + jnp.sum(resp_in)).astype(jnp.int32),
-        # any(), not sum(): during a split-brain window two live leaders can both
-        # accept the same offered command; that is ONE offer accepted, and the
-        # offered-vs-committed audit (tests/test_completeness.py) counts offers.
-        cmds_injected=jnp.any(do_inject).astype(jnp.int32),
+        # Offers accepted this tick, not appends: the direct client collapses
+        # split-brain double-accepts of one offer via any(); the redirect
+        # pipeline counts accepted slots (distinct offers) -- see phase 6.
+        cmds_injected=cmds_cnt,
         lat_sum=lat_sum,
         lat_cnt=lat_cnt,
         lat_hist=lat_hist,

@@ -428,29 +428,40 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         room = log_len - base < cap
         noop_blocked = jnp.zeros_like(s.now)
     if cfg.client_redirect:
-        have_pend = s.client_pend != NIL  # [B]
-        fresh = (inp.client_cmd != NIL) & ~have_pend
-        cmd = jnp.where(have_pend, s.client_pend, inp.client_cmd)  # [B]
-        tgt = jnp.where(have_pend, s.client_dst, inp.client_target)
-        active = have_pend | fresh
-        tgt_oh = iota((n, 1), 0) == tgt[None, :]  # [N, B]
-        client_ok = active[None, :] & tgt_oh & is_leader & inp.alive & room & ~noop
-        accepted = jnp.any(client_ok, axis=0)  # [B]
-        tgt_ld = jnp.max(jnp.where(tgt_oh, leader_id, NIL), axis=0)  # [B]
-        tgt_up = jnp.any(tgt_oh & inp.alive, axis=0)
-        pend_on = active & ~accepted
-        client_pend = jnp.where(pend_on, cmd, NIL)
+        # K-deep in-flight pipeline: first free slot takes a fresh offer, at
+        # most one slot accepted per node per tick, lowest slot first
+        # (raft.py phase 6).
+        kdim = cfg.client_pipeline
+        kk3 = iota((kdim, 1, 1), 0)  # [K, 1, 1]
+        free = s.client_pend == NIL  # [K, B]
+        first_free = free & (jnp.cumsum(free, axis=0) == 1)
+        fresh = (inp.client_cmd != NIL)[None, :] & first_free
+        pend = jnp.where(fresh, inp.client_cmd[None, :], s.client_pend)  # [K, B]
+        tgt = jnp.where(fresh, inp.client_target[None, :], s.client_dst)
+        active = pend != NIL
+        tgt_oh = active[:, None, :] & (tgt[:, None, :] == iota((1, n, 1), 1))  # [K, N, B]
+        low_k = jnp.min(jnp.where(tgt_oh, kk3, kdim), axis=0)  # [N, B]
+        node_ok = is_leader & inp.alive & room & ~noop  # [N, B]
+        client_ok = (low_k < kdim) & node_ok  # [N, B] nodes accepting a slot
+        sel_k = tgt_oh & (kk3 == low_k[None, :, :]) & node_ok[None, :, :]  # [K, N, B]
+        wval_cl = jnp.sum(jnp.where(sel_k, pend[:, None, :], 0), axis=0)  # [N, B]
+        accepted_k = jnp.any(sel_k, axis=1)  # [K, B]
+        cmds_cnt = jnp.sum(accepted_k, axis=0).astype(jnp.int32)  # [B]
+        tgt_ld = jnp.max(jnp.where(tgt_oh, leader_id[None, :, :], NIL), axis=1)  # [K, B]
+        tgt_up = jnp.any(tgt_oh & inp.alive[None, :, :], axis=1)
+        pend_on = active & ~accepted_k
+        client_pend = jnp.where(pend_on, pend, NIL)
         client_dst = jnp.where(
             pend_on, jnp.where(tgt_up & (tgt_ld != NIL), tgt_ld, inp.client_bounce), 0
         )
     else:
         client_ok = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive & room & ~noop
-        cmd = inp.client_cmd
+        wval_cl = jnp.broadcast_to(inp.client_cmd[None, :], (n, b))
+        cmds_cnt = jnp.any(client_ok, axis=0).astype(jnp.int32)  # offers, not appends
         client_pend = s.client_pend
         client_dst = s.client_dst
     do_write = noop | client_ok
-    do_inject = client_ok  # metrics count client accepts only, not leader no-ops
-    wval = jnp.where(noop, NOOP, cmd[None, :])  # [N, B]
+    wval = jnp.where(noop, NOOP, wval_cl)  # [N, B]
     # cap matches no slot -> masked-off writes dropped.
     inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)  # [N, B]
     inj_oh = iota((1, cap, 1), 1) == inj_pos[:, None, :]  # [N, CAP, B]
@@ -614,7 +625,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     )
 
     info = _step_info_b(
-        cfg, s, new_state, req_in, resp_in, inp.alive, do_inject, chk_ok,
+        cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, noop_blocked,
     )
     return new_state, info
@@ -627,7 +638,7 @@ def _step_info_b(
     req_in: jax.Array,
     resp_in: jax.Array,
     alive: jax.Array,
-    do_inject: jax.Array,
+    cmds_cnt: jax.Array,
     chk_ok: jax.Array,
     lat_sum: jax.Array,
     lat_cnt: jax.Array,
@@ -745,7 +756,7 @@ def _step_info_b(
         msgs_delivered=(
             jnp.sum(req_in, axis=(0, 1)) + jnp.sum(resp_in, axis=(0, 1))
         ).astype(jnp.int32),
-        cmds_injected=jnp.any(do_inject, axis=0).astype(jnp.int32),  # offers, not leaders; see raft.py
+        cmds_injected=cmds_cnt,  # offers accepted, not appends; see raft.py phase 6
         lat_sum=lat_sum,
         lat_cnt=lat_cnt,
         lat_hist=lat_hist,

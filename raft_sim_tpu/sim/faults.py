@@ -121,14 +121,15 @@ def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
     client_cmd = jnp.asarray(client_cmd, jnp.int32)
 
     # Client routing draws (redirect model only): the random node a fresh offer
-    # POSTs to, and the random peer a leaderless redirect bounces to.
+    # POSTs to, and the random peer each pipeline slot's leaderless redirect
+    # bounces to.
     if cfg.client_redirect:
         k_tgt, k_bnc = jax.random.split(jax.random.fold_in(tkey, 3))
         client_target = jax.random.randint(k_tgt, (), 0, n)
-        client_bounce = jax.random.randint(k_bnc, (), 0, n)
+        client_bounce = jax.random.randint(k_bnc, (cfg.client_pipeline,), 0, n)
     else:
         client_target = jnp.int32(0)
-        client_bounce = jnp.int32(0)
+        client_bounce = jnp.zeros((cfg.client_pipeline,), jnp.int32)
 
     # Crash/restart schedule (restart edge = alive now, down last tick).
     if cfg.crash_prob > 0:

@@ -211,12 +211,14 @@ class ClusterState(NamedTuple):
     log_len: jax.Array  # [N] int32
     clock: jax.Array  # [N] int32 local (skewable) clock
     deadline: jax.Array  # [N] int32 next timer fire on the local clock
-    # Client-side state (cfg.client_redirect; NIL/0 otherwise): the one command the
-    # simulated client has in flight and the node its next POST targets -- the
-    # array form of the reference client chasing HTTP 302 redirects
-    # (core.clj:151-160). Not node state: crash faults never touch it.
-    client_pend: jax.Array  # scalar int32 command value in flight (NIL = none)
-    client_dst: jax.Array  # scalar int32 node the pending command targets
+    # Client-side state (cfg.client_redirect; NIL/0 otherwise): up to K =
+    # cfg.client_pipeline commands the simulated client has in flight and the
+    # node each one's next POST targets -- the array form of the reference
+    # client chasing HTTP 302 redirects (core.clj:151-160) through a
+    # buffered(K) request channel (server.clj:37). Not node state: crash
+    # faults never touch it.
+    client_pend: jax.Array  # [K] int32 command values in flight (NIL = free slot)
+    client_dst: jax.Array  # [K] int32 node each pending command targets
     # Monotone commit-latency frontier: the highest commit index any node of this
     # cluster has ever reached. The latency metric counts an entry when the live
     # leader's commit first passes it; dedup against this CARRIED maximum (not
@@ -239,10 +241,10 @@ class StepInputs(NamedTuple):
     timeout_draw: jax.Array  # [N] int32 election timeout to use on any timer reset
     client_cmd: jax.Array  # scalar int32 command value offered this tick; NIL = none
     # Client routing draws (cfg.client_redirect; zeros otherwise): the node a
-    # fresh offer targets, and the random peer a leaderless redirect bounces to
-    # (core.clj:154).
+    # fresh offer targets, and the random peer each pipeline slot's leaderless
+    # redirect bounces to (core.clj:154).
     client_target: jax.Array  # scalar int32 in [0, N)
-    client_bounce: jax.Array  # scalar int32 in [0, N)
+    client_bounce: jax.Array  # [K] int32 in [0, N)
     alive: jax.Array  # [N] bool; False = node crashed this tick (silent, frozen)
     restarted: jax.Array  # [N] bool; True = node came back up this tick (volatile wipe)
 
@@ -340,8 +342,8 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         log_len=jnp.zeros((n,), jnp.int32),
         clock=jnp.zeros((n,), jnp.int32),
         deadline=deadline,
-        client_pend=jnp.int32(NIL),
-        client_dst=jnp.int32(0),
+        client_pend=jnp.full((cfg.client_pipeline,), NIL, jnp.int32),
+        client_dst=jnp.zeros((cfg.client_pipeline,), jnp.int32),
         lat_frontier=jnp.int32(0),
         now=jnp.int32(0),
         mailbox=empty_mailbox(cfg),

@@ -50,7 +50,9 @@ from raft_sim_tpu.utils.config import RaftConfig
 # v14: metrics v2 -- ClusterState gained lat_frontier (monotone latency dedup
 #      frontier); RunMetrics gained lat_hist (per-entry log2-bin latency
 #      histogram), noop_blocked, and lm_skipped_pairs.
-_FORMAT_VERSION = 14
+# v15: K-deep client pipeline -- client_pend/client_dst became [K] vectors
+#      (cfg.client_pipeline slots).
+_FORMAT_VERSION = 15
 
 
 def _normalize(path: str) -> str:

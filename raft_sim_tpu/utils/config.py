@@ -113,11 +113,21 @@ class RaftConfig:
     # real write path (core.clj:151-160, server.clj:62-63) -- each offer targets a
     # RANDOM node; a non-leader target redirects the client to its known leader
     # (the HTTP 302 analogue, costing one tick per bounce) or to a random peer
-    # when leaderless (core.clj:154); the client keeps one command in flight and
-    # drops new offers while busy. Offer->commit latency is tracked either way
+    # when leaderless (core.clj:154); the client keeps up to `client_pipeline`
+    # commands in flight and drops offers only when every slot is busy.
+    # Offer->commit latency is tracked either way
     # (RunMetrics.lat_sum/lat_cnt; the reference's commit watch, log.clj:83-87,
     # never fired -- bug 2.3.9).
     client_redirect: bool = False
+    # In-flight client pipeline depth K (redirect mode only): the simulated
+    # client holds up to K commands in flight, each independently chasing 302
+    # redirects -- the array form of the reference's buffered(5) request channel
+    # with one private response channel per pending client-set
+    # (server.clj:18-23, 37). A fresh offer takes the first free slot (dropped
+    # only when all K are busy); at most one slot is accepted per NODE per tick
+    # (the reference's loop dequeues one message per wait iteration), lowest
+    # slot first. 1 = the round-4 single-command client.
+    client_pipeline: int = 1
 
     # On-device safety checking (north star: invariants checked every tick)
     check_invariants: bool = True
@@ -151,6 +161,10 @@ class RaftConfig:
             assert self.crash_period >= 2
             assert 1 <= self.crash_down_ticks <= self.crash_period
         assert self.log_matching_interval >= 1
+        # The pipeline is client-side redirect state; the omniscient direct
+        # client never queues.
+        assert self.client_pipeline == 1 or self.client_redirect
+        assert 1 <= self.client_pipeline <= 16
         # Compaction slack: client injections stop max(1, margin // 2) slots short
         # of the ring so election no-ops always find room (models/raft.py phase 6);
         # margin >= 2 keeps that client ceiling above the steady-state retained
@@ -236,7 +250,8 @@ PRESETS: dict[str, tuple[RaftConfig, int]] = {
     ),
     # config6 through the reference's real write path (curl -> 302 redirect
     # chase, core.clj:151-160, server.clj:62-63): every offer targets a random
-    # node, bounces cost one tick each, one command in flight per cluster.
+    # node, bounces cost one tick each, and the client holds up to 5 commands
+    # in flight -- the reference's buffered(5) request channel (server.clj:37).
     "config6r": (
         RaftConfig(
             n_nodes=5,
@@ -249,6 +264,7 @@ PRESETS: dict[str, tuple[RaftConfig, int]] = {
             crash_period=64,
             crash_down_ticks=12,
             client_redirect=True,
+            client_pipeline=5,
         ),
         1_000,
     ),

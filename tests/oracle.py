@@ -370,8 +370,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     cmd_in = int(inp["client_cmd"])
     comp = cfg.compact_margin > 0
     reserve = max(1, cfg.compact_margin // 2)
-    client_pend = int(s["client_pend"])
-    client_dst = int(s["client_dst"])
+    K = cfg.client_pipeline
+    client_pend = [int(x) for x in np.atleast_1d(s["client_pend"])]
+    client_dst = [int(x) for x in np.atleast_1d(s["client_dst"])]
 
     def noop_at(d):
         return comp and win[d] and int(log_len[d]) - int(log_base[d]) < cap
@@ -386,31 +387,37 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         log_len[d] += 1
 
     if cfg.client_redirect:
-        # One command in flight, chasing 302 redirects (raft.py phase 6).
-        have = client_pend != NIL
-        fresh = cmd_in != NIL and not have
-        c = client_pend if have else cmd_in
-        t = int(client_dst) if have else int(inp["client_target"])
-        active = have or fresh
-        accepted = (
-            active
-            and role[t] == LEADER
-            and alive[t]
-            and room_at(t)
-            and not noop_at(t)
-        )
-        accept_at = {t} if accepted else set()
-        if active and not accepted:
-            tl = int(leader_id[t])
-            client_pend = c
-            client_dst = tl if (alive[t] and tl != NIL) else int(inp["client_bounce"])
-        else:
-            client_pend, client_dst = NIL, 0
+        # K commands in flight chasing 302 redirects (raft.py phase 6): a fresh
+        # offer takes the first free slot; at most one slot is accepted per
+        # node per tick, lowest slot index first.
+        pend = list(client_pend)
+        tgt = list(client_dst)
+        if cmd_in != NIL:
+            for k in range(K):
+                if pend[k] == NIL:
+                    pend[k] = cmd_in
+                    tgt[k] = int(inp["client_target"])
+                    break
+        accepted = [False] * K
         for d in range(n):
             if noop_at(d):
                 append(d, NOOP)
-            elif d in accept_at:
-                append(d, c)
+                continue
+            here = [k for k in range(K) if pend[k] != NIL and tgt[k] == d]
+            if here and role[d] == LEADER and alive[d] and room_at(d):
+                k = min(here)
+                append(d, pend[k])
+                accepted[k] = True
+        for k in range(K):
+            if pend[k] != NIL and not accepted[k]:
+                t = tgt[k]
+                tl = int(leader_id[t])
+                client_pend[k] = pend[k]
+                client_dst[k] = (
+                    tl if (alive[t] and tl != NIL) else int(inp["client_bounce"][k])
+                )
+            else:
+                client_pend[k], client_dst[k] = NIL, 0
     else:
         for d in range(n):
             if noop_at(d):
@@ -554,8 +561,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "log_len": log_len,
         "clock": clock,
         "deadline": deadline,
-        "client_pend": np.int32(client_pend),
-        "client_dst": np.int32(client_dst),
+        "client_pend": np.asarray(client_pend, np.int32),
+        "client_dst": np.asarray(client_dst, np.int32),
         "lat_frontier": np.int32(lat_frontier),
         "now": np.int32(int(s["now"]) + 1),
         "mailbox": out,

@@ -67,6 +67,21 @@ CONFIGS = [
         id="n5-redirect-compaction",  # 302 routing state + latency metric riding
         # the compaction ring
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            client_interval=1,
+            client_redirect=True,
+            client_pipeline=4,
+            drop_prob=0.2,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        id="n5-redirect-pipeline",  # K = 4 in-flight slots ([K, B] client state)
+    ),
 ]
 
 

@@ -25,7 +25,7 @@ def offer_inputs(cfg, cmd, target, bounce=0):
     return quiet_inputs(cfg)._replace(
         client_cmd=jnp.int32(cmd),
         client_target=jnp.int32(target),
-        client_bounce=jnp.int32(bounce),
+        client_bounce=jnp.full((cfg.client_pipeline,), bounce, jnp.int32),
     )
 
 
@@ -34,7 +34,7 @@ def test_offer_at_leader_accepted_same_tick():
     s2, info = step(CFG_R, s, offer_inputs(CFG_R, 50, target=0))
     assert int(s2.log_len[0]) == 1
     assert int(s2.log_val[0, 0]) == 50
-    assert int(s2.client_pend) == NIL
+    assert int(s2.client_pend[0]) == NIL
     assert int(info.cmds_injected) == 1
 
 
@@ -47,13 +47,13 @@ def test_redirect_via_follower_costs_exactly_one_tick():
     # tick 1: the follower redirects; nothing lands anywhere
     assert int(jnp.max(s2.log_len)) == 0
     assert int(info.cmds_injected) == 0
-    assert int(s2.client_pend) == 50
-    assert int(s2.client_dst) == 0  # redirected to the known leader
+    assert int(s2.client_pend[0]) == 50
+    assert int(s2.client_dst[0]) == 0  # redirected to the known leader
     # tick 2: the redirected POST lands on the leader
     s3, info2 = step(CFG_R, s2, quiet_inputs(CFG_R))
     assert int(s3.log_len[0]) == 1
     assert int(s3.log_val[0, 0]) == 50
-    assert int(s3.client_pend) == NIL
+    assert int(s3.client_pend[0]) == NIL
     assert int(info2.cmds_injected) == 1
 
 
@@ -63,8 +63,8 @@ def test_leaderless_offer_bounces_to_random_peer():
     s = base_state(CFG_R)  # all followers, leader_id NIL everywhere
     s2, info = step(CFG_R, s, offer_inputs(CFG_R, 50, target=2, bounce=3))
     assert int(info.cmds_injected) == 0
-    assert int(s2.client_pend) == 50
-    assert int(s2.client_dst) == 3
+    assert int(s2.client_pend[0]) == 50
+    assert int(s2.client_dst[0]) == 3
     assert int(jnp.max(s2.log_len)) == 0
 
 
@@ -72,12 +72,15 @@ def test_busy_client_drops_fresh_offers():
     """One command in flight at a time: a new offer while one is pending is
     dropped (the one-curl-at-a-time reference client)."""
     s = make_leader(base_state(CFG_R), 0, 2)
-    s = s._replace(client_pend=jnp.int32(50), client_dst=jnp.int32(0))
+    s = s._replace(
+        client_pend=jnp.full((1,), 50, jnp.int32),
+        client_dst=jnp.zeros((1,), jnp.int32),
+    )
     s2, info = step(CFG_R, s, offer_inputs(CFG_R, 60, target=0))
     # the pending 50 lands; the fresh 60 is dropped, not queued
     assert int(s2.log_len[0]) == 1
     assert int(s2.log_val[0, 0]) == 50
-    assert int(s2.client_pend) == NIL
+    assert int(s2.client_pend[0]) == NIL
     assert int(info.cmds_injected) == 1
 
 
@@ -89,8 +92,8 @@ def test_dead_target_bounces_instead_of_trusting_its_leader():
         alive=jnp.ones((CFG_R.n_nodes,), bool).at[2].set(False)
     )
     s2, _ = step(CFG_R, s, inp)
-    assert int(s2.client_pend) == 50
-    assert int(s2.client_dst) == 4  # bounce, not node 2's leader_id
+    assert int(s2.client_pend[0]) == 50
+    assert int(s2.client_dst[0]) == 4  # bounce, not node 2's leader_id
 
 
 def test_commit_latency_metric_direct_vs_redirect():
@@ -154,6 +157,62 @@ def test_session_offer_value_collision_never_false_positives():
     sess.run(200)  # scheduled value 41 (offer tick 40) committed long ago
     res = sess.offer(41, wait=0)
     assert res["committed"] == 0
+
+
+# ----------------------------------------------- K-deep in-flight pipeline (K > 1)
+
+CFG_P = RaftConfig(n_nodes=5, log_capacity=8, client_redirect=True, client_pipeline=3)
+
+
+def test_pipeline_queues_offers_instead_of_dropping():
+    """With K slots, fresh offers queue while earlier ones are still bouncing;
+    only a FULL pipeline drops (the reference's buffered(5) request channel,
+    server.clj:37)."""
+    s = base_state(CFG_P)  # leaderless: every offer keeps bouncing
+    for i, cmd in enumerate((50, 60, 70)):
+        s, info = step(CFG_P, s, offer_inputs(CFG_P, cmd, target=2, bounce=3))
+        assert int(info.cmds_injected) == 0
+    assert [int(x) for x in s.client_pend] == [50, 60, 70]
+    # Pipeline full: the fourth offer is dropped, the three stay in flight.
+    s, info = step(CFG_P, s, offer_inputs(CFG_P, 80, target=2, bounce=3))
+    assert [int(x) for x in s.client_pend] == [50, 60, 70]
+
+
+def test_pipeline_accepts_one_slot_per_node_per_tick_lowest_first():
+    """Two pending slots targeting the same leader: the lowest slot lands this
+    tick (the reference dequeues one message per wait iteration); the other
+    stays pending and lands next tick."""
+    s = make_leader(base_state(CFG_P), 0, 2)
+    s = s._replace(
+        client_pend=jnp.asarray([50, 60, NIL], jnp.int32),
+        client_dst=jnp.zeros((3,), jnp.int32),
+    )
+    s2, info = step(CFG_P, s, quiet_inputs(CFG_P))
+    assert int(s2.log_len[0]) == 1
+    assert int(s2.log_val[0, 0]) == 50  # lowest slot first
+    assert int(info.cmds_injected) == 1
+    assert [int(x) for x in s2.client_pend] == [NIL, 60, NIL]
+    s3, info2 = step(CFG_P, s2, quiet_inputs(CFG_P))
+    assert int(s3.log_len[0]) == 2
+    assert int(s3.log_val[0, 1]) == 60
+    assert int(info2.cmds_injected) == 1
+
+
+def test_pipeline_no_drop_and_all_commit_end_to_end():
+    """Offers beyond one-in-flight are not lost: a K=4 pipeline under a fast
+    offer cadence accepts strictly more than the K=1 client on the same
+    trajectory seeds, and everything offered-and-accepted commits (0
+    violations)."""
+    base = dict(
+        n_nodes=5, log_capacity=32, compact_margin=8, client_interval=2,
+        client_redirect=True,
+    )
+    _, m1 = scan.simulate(RaftConfig(**base), 0, 32, 600)
+    _, m4 = scan.simulate(RaftConfig(**base, client_pipeline=4), 0, 32, 600)
+    s1, s4 = summarize(m1), summarize(m4)
+    assert s1.total_violations == 0 and s4.total_violations == 0
+    assert s4.total_cmds > s1.total_cmds  # the queue absorbs bounce latency
+    assert s4.lat_p50 is not None
 
 
 def test_manual_offer_values_do_not_corrupt_latency_metric():

@@ -37,7 +37,7 @@ def quiet_inputs(cfg, far=1000):
         timeout_draw=jnp.full((n,), far, jnp.int32),
         client_cmd=jnp.int32(NIL),
         client_target=jnp.int32(0),
-        client_bounce=jnp.int32(0),
+        client_bounce=jnp.zeros((cfg.client_pipeline,), jnp.int32),
         alive=jnp.ones((n,), bool),
         restarted=jnp.zeros((n,), bool),
     )

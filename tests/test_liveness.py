@@ -33,7 +33,7 @@ def run_ticks(cfg, s, n_ticks, alive, cmd_base=100):
             timeout_draw=jnp.full((n,), 8 + (t % 5), jnp.int32),
             client_cmd=jnp.int32(cmd_base + t),
             client_target=jnp.int32(0),
-            client_bounce=jnp.int32(0),
+            client_bounce=jnp.zeros((cfg.client_pipeline,), jnp.int32),
             alive=jnp.asarray(alive, bool),
             restarted=jnp.zeros((n,), bool),
         )
@@ -78,7 +78,7 @@ def test_healed_laggard_catches_up():
         timeout_draw=jnp.full((n,), 9, jnp.int32),
         client_cmd=jnp.int32(NIL),
         client_target=jnp.int32(0),
-        client_bounce=jnp.int32(0),
+        client_bounce=jnp.zeros((CFG.client_pipeline,), jnp.int32),
         alive=jnp.ones((n,), bool),
         restarted=jnp.asarray([i == 4 for i in range(n)], bool),
     )

@@ -136,6 +136,24 @@ CONFIGS = [
         id="n5-redirect-compaction",  # routing state and election no-ops riding
         # the compaction ring (the full round-4 feature interaction)
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            client_interval=1,
+            client_redirect=True,
+            client_pipeline=4,
+            drop_prob=0.2,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        10,
+        id="n5-redirect-pipeline",  # K = 4 commands in flight: slot fill/free
+        # churn, per-node lowest-slot acceptance, parallel accepts at
+        # split-brain leaders, per-slot bounce draws
+    ),
 ]
 
 
@@ -159,8 +177,8 @@ def test_trajectory_parity(cfg, seed):
 
 
 def test_parity_at_int16_index_boundary():
-    """CAP-scale log indices riding the narrow planes: next/match (int16) and the
-    packed response word's 12-bit match field near its MAX_LOG_CAPACITY = 4095
+    """CAP-scale log indices riding the narrow planes: next/match and the
+    per-responder match/hint wire fields (int16) near the MAX_LOG_CAPACITY = 4095
     ceiling. The small-CAP rows above never push an index past 8; here every node
     starts with ~3980 committed-prefix entries, so election bookkeeping, append
     acks, and capacity rejection all run with indices in the 3980..4095 range --
