@@ -79,6 +79,7 @@ class Session:
         self.batch = batch
         self.seed = seed
         self.devices = devices
+        self.apply_writer = None
         self.reset()
 
     def reset(self) -> None:
@@ -90,6 +91,11 @@ class Session:
         self.keys = jax.random.split(k_run, self.batch)
         self.metrics = scan.init_metrics_batch(self.batch)
         self._apply_sharding()
+        # A rebuilt experiment gets a rebuilt export stream: re-attach truncates
+        # the files and zeroes the writer's frontier (a stale frontier would
+        # silently drop the new run's early commits).
+        if self.apply_writer is not None:
+            self.attach_apply_log(self.apply_writer.directory, self.apply_writer.cluster)
 
     def _apply_sharding(self) -> None:
         if self.devices is None:
@@ -112,8 +118,24 @@ class Session:
         self.keys = jax.device_put(self.keys, sh)
         self.metrics = place(self.metrics)
 
+    def attach_apply_log(self, directory: str, cluster: int = 0) -> None:
+        """Stream the selected cluster's committed values to per-node files --
+        the reference's `node_<id>.log` apply stream (log.clj:16-18, 74-75),
+        exported at chunk boundaries during run(). Keep chunks small enough
+        that commit advances by less than CAP - compact_margin per chunk, or
+        compacted-away spans appear as `# snapshot gap` markers
+        (utils/apply_log.py)."""
+        from raft_sim_tpu.utils.apply_log import ApplyLogWriter
+
+        if not 0 <= cluster < self.batch:
+            raise IndexError(f"cluster {cluster} out of range for batch {self.batch}")
+        self.apply_writer = ApplyLogWriter(directory, self.cfg, cluster)
+        self.apply_writer.update(self.state)  # anything already committed
+
     def run(self, n_ticks: int, chunk: int = 4096, progress: bool = False) -> None:
-        def cb(done, _state, metrics):
+        def cb(done, state, metrics):
+            if self.apply_writer is not None:
+                self.apply_writer.update(state)
             if progress:
                 v = int(np.sum(np.asarray(metrics.violations)))
                 print(f"  {done}/{n_ticks} ticks, violations={v}", file=sys.stderr)
@@ -210,6 +232,7 @@ class Session:
         checkpoint is device-layout agnostic)."""
         cfg, state, keys, metrics, seed = checkpoint.load(path)
         self = cls.__new__(cls)
+        self.apply_writer = None
         self.cfg = cfg
         self.batch = state.role.shape[0]
         self.seed = seed
@@ -302,6 +325,12 @@ def main(argv=None) -> int:
     run_p.add_argument("--trace-cluster", type=int, default=0)
     run_p.add_argument("--save", metavar="PATH", help="write a checkpoint at the end")
     run_p.add_argument("--resume", metavar="PATH", help="start from a checkpoint")
+    run_p.add_argument("--apply-log", metavar="DIR", default=None,
+                       help="stream one cluster's committed values to "
+                            "DIR/node_<i>.log (the reference's per-node apply "
+                            "file, log.clj:74-75)")
+    run_p.add_argument("--apply-cluster", type=int, default=0,
+                       help="cluster index --apply-log exports (default 0)")
     _add_config_flags(run_p)
 
     sub.add_parser("presets", help="list the BASELINE config presets")
@@ -351,9 +380,10 @@ def main(argv=None) -> int:
             ap.error(str(ex))
 
     if args.trace_ticks or args.trace_events:
-        if args.save or args.profile:
-            ap.error("--save/--profile have no effect with --trace-ticks/"
-                     "--trace-events (tracing does not advance the session)")
+        if args.save or args.profile or args.apply_log:
+            ap.error("--save/--profile/--apply-log have no effect with "
+                     "--trace-ticks/--trace-events (tracing does not advance "
+                     "the session)")
         n = args.trace_ticks or args.ticks
         infos, states = sess.trace(n, cluster=args.trace_cluster)
         if args.trace_events:
@@ -363,6 +393,12 @@ def main(argv=None) -> int:
             for line in trace.info_lines(infos):
                 print(line)
         return 0
+
+    if args.apply_log:
+        try:
+            sess.attach_apply_log(args.apply_log, cluster=args.apply_cluster)
+        except IndexError as ex:
+            ap.error(str(ex))
 
     import contextlib
 

@@ -27,8 +27,11 @@ over int16 not implemented" -- re-confirmed on the real chip in round 4, which
 triggered the demotion round 2's park decision called for. Meanwhile the XLA
 batch-minor path hit 38.2M cluster-ticks/s/chip (config3) with XLA's own fusions,
 so the headroom a hand-fused kernel could add no longer justifies maintaining a
-second compile path against a toolchain that cannot lower it. Revisit if
-libtpu/Mosaic gains int16 reductions.
+second compile path against a toolchain that cannot lower it. Round-5 probe
+(one per round, per the standing plan): still blocked, now "Reductions over
+int8 not implemented" after the v13 int8 index planes -- the same missing
+narrow-int reduction support, one dtype lower. Revisit if libtpu/Mosaic gains
+sub-int32 reductions.
 """
 
 from __future__ import annotations

@@ -1,0 +1,112 @@
+"""Committed-value export stream -- the `node_<id>.log` analogue.
+
+The reference's only durable artifact is an append-only file per node to which
+every committed value is written at apply time: the writer is opened per node
+(log.clj:32, filename `node_<id>.log` from core.clj:17) and `apply-entries!`
+appends each newly committed value plus newline (log.clj:16-18, 74-75). The
+file is never read back -- it exists as a host-observable apply stream.
+
+The simulator's equivalent: an `ApplyLogWriter` attached to ONE selected
+cluster exports each node's newly committed values to `node_<i>.log` in a
+directory, appended at chunk boundaries (driver.Session.run drives it between
+jitted chunks; the values are read host-side from the ring, so the export
+costs one tiny device_get per chunk and nothing inside the scan).
+
+Two deliberate deltas from a naive file tail:
+  - Leader no-op entries (types.NOOP, appended on election wins under
+    compaction) are internal protocol filler, not applied client values --
+    they are skipped, so the stream is exactly the committed CLIENT values.
+  - Ring compaction can discard entries before they were ever exported (a
+    node that catches up via the InstallSnapshot analogue never materializes
+    the compacted prefix -- there is nothing to read). Such spans appear as a
+    `# snapshot gap A..B` marker line, mirroring what the reference node
+    would experience if it could snapshot: the values themselves are simply
+    not observable at this node. On healthy chunk cadences (chunk ticks small
+    enough that commit advances less than CAP - margin per chunk) no gaps
+    occur; tests/test_apply_log.py pins both regimes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from raft_sim_tpu.types import NOOP
+from raft_sim_tpu.utils.config import RaftConfig
+
+
+class ApplyLogWriter:
+    """Appends newly committed values of one cluster to per-node files.
+
+    `update(state)` exports everything committed since the last call; call it
+    at chunk boundaries (Session wires this automatically) and once at the end.
+    Files are truncated on construction (the reference's writer also starts
+    fresh per process, log.clj:32).
+    """
+
+    def __init__(self, directory: str, cfg: RaftConfig, cluster: int = 0):
+        self.cfg = cfg
+        self.cluster = cluster
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.paths = [
+            os.path.join(directory, f"node_{i}.log") for i in range(cfg.n_nodes)
+        ]
+        for p in self.paths:
+            open(p, "w").close()
+        # Last exported 1-based entry index per node (host-side, monotone --
+        # a restarted node's regressed commit simply exports nothing new).
+        self.frontier = [0] * cfg.n_nodes
+
+    def update(self, state) -> int:
+        """Export entries committed since the last call. `state` is the batched
+        [B, ...] ClusterState; returns the number of values written. Only the
+        three leaves the export reads cross to the host (commit, base, values
+        of the one selected cluster) -- not the whole state."""
+        c = self.cluster
+        commits, bases, log_vals = jax.device_get(
+            (state.commit_index[c], state.log_base[c], state.log_val[c])
+        )
+        cap = self.cfg.log_capacity
+        written = 0
+        for i in range(self.cfg.n_nodes):
+            commit = int(commits[i])
+            base = int(bases[i])
+            f = self.frontier[i]
+            if commit <= f:
+                continue
+            with open(self.paths[i], "a") as fh:
+                if f < base:
+                    # Entries (f, base] were compacted before this export saw
+                    # them: they exist only as the snapshot triple.
+                    fh.write(f"# snapshot gap {f + 1}..{base}\n")
+                    f = base
+                vals = np.asarray(log_vals[i])
+                for idx1 in range(f + 1, commit + 1):
+                    v = int(vals[(idx1 - 1) % cap])
+                    if v != NOOP:
+                        fh.write(f"{v}\n")
+                        written += 1
+            self.frontier[i] = commit
+        return written
+
+    def values(self, node: int) -> list[int]:
+        """The exported value stream of one node (gap markers excluded)."""
+        out = []
+        with open(self.paths[node]) as fh:
+            for line in fh:
+                if not line.startswith("#"):
+                    out.append(int(line))
+        return out
+
+    def gaps(self, node: int) -> list[tuple[int, int]]:
+        """(first, last) 1-based index spans lost to compaction at `node`."""
+        out = []
+        with open(self.paths[node]) as fh:
+            for line in fh:
+                if line.startswith("# snapshot gap "):
+                    a, b = line.split()[-1].split("..")
+                    out.append((int(a), int(b)))
+        return out
