@@ -177,6 +177,10 @@ class Session:
         self.state, self.metrics, accepted = _offer_tick(
             self.cfg, self.state, self.keys, self.metrics, value
         )
+        if self.apply_writer is not None:
+            # offer() ticks outside run()'s chunk loop: keep the export stream
+            # current even when offer() is the session's last action.
+            self.apply_writer.update(self.state)
         accepted = int(np.sum(np.asarray(accepted)))
         fresh = lambda: int((self._committed_mask(value) & ~before).sum())
         committed, waited = fresh(), 0

@@ -63,9 +63,12 @@ from raft_sim_tpu.types import (
     LEADER,
     NIL,
     NOOP,
+    PRECANDIDATE,
     REQ_APPEND,
+    REQ_PREVOTE,
     REQ_VOTE,
     RESP_APPEND,
+    RESP_PREVOTE,
     RESP_VOTE,
     ClusterState,
     Mailbox,
@@ -104,6 +107,13 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
+    if cfg.pre_vote:
+        # A restarted node remembers no leader contact: "quiet" immediately.
+        s = s._replace(
+            heard_clock=jnp.where(
+                rs, s.clock - cfg.election_min_ticks, s.heard_clock
+            )
+        )
     mb = s.mailbox
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk
 
@@ -127,9 +137,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # ---- phase 1: term adoption --------------------------------------------------
     # Spec: any RPC (request or response) with term T > currentTerm -> set
     # currentTerm = T, convert to follower. The reference does this for responses
-    # (core.clj:129-130, 144-145) but not vote requests (bug 2.3.2).
+    # (core.clj:129-130, 144-145) but not vote requests (bug 2.3.2). A PreVote
+    # request's term is PROSPECTIVE (thesis 9.6) -- it must never be adopted.
+    if cfg.pre_vote:
+        term_req = req_in & (mb.req_type != REQ_PREVOTE)[:, None]
+    else:
+        term_req = req_in
     in_term = jnp.maximum(
-        jnp.max(jnp.where(req_in, mb.req_term[:, None], 0), axis=0),
+        jnp.max(jnp.where(term_req, mb.req_term[:, None], 0), axis=0),
         jnp.max(jnp.where(resp_in, mb.resp_term[None, :], 0), axis=1),
     )  # [N]
     saw_higher = in_term > s.term
@@ -213,9 +228,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     ent_term_in = log_ops.window(w_term, off, e)  # [N, E]
     ent_val_in = log_ops.window(w_val, off, e)
 
-    # A valid AE from the current term makes candidates step down and identifies the
-    # leader (core.clj:121-123, minus the :follwer typo, bug 2.3.1).
-    role = jnp.where(has_ae & (role == CANDIDATE), FOLLOWER, role)
+    # A valid AE from the current term makes candidates (and pre-candidates)
+    # step down and identifies the leader (core.clj:121-123, minus the :follwer
+    # typo, bug 2.3.1).
+    if cfg.pre_vote:
+        stepdown = (role == CANDIDATE) | (role == PRECANDIDATE)
+    else:
+        stepdown = role == CANDIDATE
+    role = jnp.where(has_ae & stepdown, FOLLOWER, role)
     leader_id = jnp.where(has_ae, ae_src, leader_id)
 
     # Consistency check (spec 5.3; reference compare-prev? has bugs 2.3.4/2.3.5).
@@ -328,6 +348,24 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     out_a_match = out_a_match.astype(idt)  # bounded by the responder's log length
     out_a_hint = log_len.astype(idt)  # post-append, pre-injection (phase 6 rebinds)
 
+    # ---- phase 3.5: PreVote requests (thesis 9.6; cfg.pre_vote) ------------------
+    # Grant iff the probe's prospective term is not behind us, the probing log is
+    # up to date (the phase-2 check -- probes fill the same req_last_* header),
+    # and we are QUIET: not a leader ourselves and no valid AppendEntries
+    # accepted within the minimum election timeout (including this tick's).
+    # Grants are non-binding: no votedFor, no term change, no timer reset.
+    if cfg.pre_vote:
+        clock_pv = s.clock + inp.skew  # phase 7's clock; duplicated, CSE'd
+        heard = jnp.where(has_ae, clock_pv, s.heard_clock)  # [N]
+        is_pv = req_in & (mb.req_type == REQ_PREVOTE)[:, None]  # [cand, voter]
+        quiet = (clock_pv - heard >= cfg.election_min_ticks) & (role != LEADER)
+        pv_grant = (
+            is_pv & (mb.req_term[:, None] >= term[None, :]) & up_to_date & quiet[None, :]
+        )
+        pv_out = is_pv
+    else:
+        heard = s.heard_clock
+
     # ---- phase 4: responses ------------------------------------------------------
     # Vote tally (vote-response-handler core.clj:125-139; dedup via bitmap mirrors the
     # reference's set, core.clj:133-134). Granted = this responder's one grant
@@ -351,6 +389,24 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     len_i = log_len.astype(s.next_index.dtype)
     next_index = jnp.where(win[:, None], (len_i + 1)[:, None], s.next_index)
     match_index = jnp.where(win[:, None], 0, s.match_index)
+
+    # ---- phase 4.5: PreVote responses + promotion (thesis 9.6; cfg.pre_vote) -----
+    # A pre-candidate banks grant bits in the votes bitmap (it is never a real
+    # candidate at the same time, so the bitmap is free); a pre-quorum promotes
+    # it to a REAL candidate: only now does the term bump, the self-vote land,
+    # and a real RequestVote broadcast go out (phase 8 via start_election).
+    if cfg.pre_vote:
+        pvresp = resp_in & ((mb.resp_kind & 3) == RESP_PREVOTE)
+        new_pv = pvresp & (mb.resp_kind >= 4) & (role == PRECANDIDATE)[:, None]
+        votes = votes | new_pv
+        n_pv = jnp.sum(votes, axis=1).astype(jnp.int32)
+        pre_win = (role == PRECANDIDATE) & (n_pv >= cfg.quorum) & inp.alive
+        term = term + pre_win
+        role = jnp.where(pre_win, CANDIDATE, role)
+        voted_for = jnp.where(pre_win, ids, voted_for)
+        votes = jnp.where(pre_win[:, None], eye, votes)
+    else:
+        pre_win = jnp.zeros((n,), bool)
 
     # Append responses (append-response-handler core.clj:141-149), leaders only, same
     # term. Success: match = acked index, next = match+1 (the reference sets next =
@@ -572,6 +628,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     reset_election = granted_any | has_ae | saw_higher
     deadline = jnp.where(reset_election, clock + inp.timeout_draw, s.deadline)
     deadline = jnp.where(win, clock + cfg.heartbeat_ticks, deadline)
+    if cfg.pre_vote:
+        # A just-promoted candidate draws a fresh election timeout.
+        deadline = jnp.where(pre_win, clock + inp.timeout_draw, deadline)
     # A down node's timers cannot fire; its fresh deadline is set by the restart wipe.
     expired = (clock >= deadline) & inp.alive
 
@@ -581,13 +640,25 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
 
     # Follower/candidate timeout -> new election (timeout-handler core.clj:166-169,
     # follower->candidate core.clj:69-73: term++, vote self).
-    start_election = expired & ~is_leader
-    term = term + start_election
-    role = jnp.where(start_election, CANDIDATE, role)
-    voted_for = jnp.where(start_election, ids, voted_for)
-    leader_id = jnp.where(start_election, NIL, leader_id)
-    votes = jnp.where(start_election[:, None], eye, votes)
-    deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
+    if cfg.pre_vote:
+        # Expiry starts a PRE-vote probe instead: no term bump, votedFor
+        # untouched (grants stay possible), the self pre-vote rides the bitmap.
+        # The REAL election start is this tick's promotions (phase 4.5).
+        start_prevote = expired & ~is_leader
+        role = jnp.where(start_prevote, PRECANDIDATE, role)
+        leader_id = jnp.where(start_prevote, NIL, leader_id)
+        votes = jnp.where(start_prevote[:, None], eye, votes)
+        deadline = jnp.where(start_prevote, clock + inp.timeout_draw, deadline)
+        start_election = pre_win
+    else:
+        start_prevote = jnp.zeros((n,), bool)
+        start_election = expired & ~is_leader
+        term = term + start_election
+        role = jnp.where(start_election, CANDIDATE, role)
+        voted_for = jnp.where(start_election, ids, voted_for)
+        leader_id = jnp.where(start_election, NIL, leader_id)
+        votes = jnp.where(start_election[:, None], eye, votes)
+        deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
 
     # ---- phase 8: outbox ---------------------------------------------------------
     send_append = win | heartbeat  # fresh leaders heartbeat immediately (core.clj:137-138)
@@ -604,6 +675,16 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     out_req_type = jnp.where(
         start_election, REQ_VOTE, jnp.where(send_append, REQ_APPEND, 0)
     )  # [N]
+    if cfg.pre_vote:
+        out_req_type = jnp.where(start_prevote, REQ_PREVOTE, out_req_type)
+        rv_like = start_election | start_prevote  # both fill the req_last header
+    else:
+        rv_like = start_election
+    out_req_term = jnp.where(out_req_type != 0, term, 0)
+    if cfg.pre_vote:
+        # The probe carries the PROSPECTIVE term (term + 1, thesis 9.6); phase 1
+        # excludes it from adoption.
+        out_req_term = jnp.where(start_prevote, term + 1, out_req_term)
     # AE: prev = nextIndex - 1 per edge, carried as the offset into the shared window.
     prev_out = jnp.clip(next_index - 1, 0, log_len[:, None])  # [src, dst]
     # Shared window start: minimum prev over RESPONSIVE peers (acked an AE within
@@ -658,6 +739,13 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     out_resp_kind = (
         jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
     ).astype(jnp.int8)
+    if cfg.pre_vote:
+        # Pre-vote responses overlay the same plane; the grant rides bit 2
+        # (kind = RESP_PREVOTE | granted << 2 -- per edge, since one voter may
+        # grant several probes per tick).
+        out_resp_kind = out_resp_kind + (
+            jnp.where(pv_out, RESP_PREVOTE, 0) + jnp.where(pv_grant, 4, 0)
+        ).astype(jnp.int8)
     pterm = (
         log_ops.term_at_r(log_term_arr, base, bterm, ws)
         if comp
@@ -666,10 +754,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
 
     new_mb = Mailbox(
         req_type=out_req_type,
-        req_term=jnp.where(out_req_type != 0, term, 0),
+        req_term=out_req_term,
         req_commit=jnp.where(send_append, commit, 0),
-        req_last_index=jnp.where(start_election, new_last_idx, 0),
-        req_last_term=jnp.where(start_election, new_last_term, 0),
+        req_last_index=jnp.where(rv_like, new_last_idx, 0),
+        req_last_term=jnp.where(rv_like, new_last_term, 0),
         ent_start=jnp.where(send_append, ws, 0),
         ent_prev_term=jnp.where(send_append, pterm, 0),
         ent_count=jnp.where(send_append, n_ship, 0),
@@ -710,6 +798,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         log_len=log_len,
         clock=clock,
         deadline=deadline,
+        heard_clock=heard,
         client_pend=client_pend,
         client_dst=client_dst,
         lat_frontier=lat_frontier,

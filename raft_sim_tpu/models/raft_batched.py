@@ -28,9 +28,12 @@ from raft_sim_tpu.types import (
     LEADER,
     NIL,
     NOOP,
+    PRECANDIDATE,
     REQ_APPEND,
+    REQ_PREVOTE,
     REQ_VOTE,
     RESP_APPEND,
+    RESP_PREVOTE,
     RESP_VOTE,
     ClusterState,
     Mailbox,
@@ -81,6 +84,13 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
+    if cfg.pre_vote:
+        # A restarted node remembers no leader contact: "quiet" immediately.
+        s = s._replace(
+            heard_clock=jnp.where(
+                rs, s.clock - cfg.election_min_ticks, s.heard_clock
+            )
+        )
     mb = s.mailbox
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk  # [N, B]
 
@@ -98,9 +108,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     req_in = deliver_req & (mb.req_type != 0)[:, None, :]
     resp_in = deliver_resp & (mb.resp_kind != 0)
 
-    # ---- phase 1: term adoption --------------------------------------------------
+    # ---- phase 1: term adoption (PreVote probes carry a PROSPECTIVE term:
+    # never adopted -- raft.py phase 1) -------------------------------------------
+    if cfg.pre_vote:
+        term_req = req_in & (mb.req_type != REQ_PREVOTE)[:, None, :]
+    else:
+        term_req = req_in
     in_term = jnp.maximum(
-        jnp.max(jnp.where(req_in, mb.req_term[:, None, :], 0), axis=0),
+        jnp.max(jnp.where(term_req, mb.req_term[:, None, :], 0), axis=0),
         jnp.max(jnp.where(resp_in, mb.resp_term[None, :, :], 0), axis=1),
     )  # [N, B]
     saw_higher = in_term > s.term
@@ -181,7 +196,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     ent_term_in = log_ops.window_b(w_term_in, off, e)  # [N, E, B]
     ent_val_in = log_ops.window_b(w_val_in, off, e)
 
-    role = jnp.where(has_ae & (role == CANDIDATE), FOLLOWER, role)
+    if cfg.pre_vote:
+        stepdown = (role == CANDIDATE) | (role == PRECANDIDATE)
+    else:
+        stepdown = role == CANDIDATE
+    role = jnp.where(has_ae & stepdown, FOLLOWER, role)
     leader_id = jnp.where(has_ae, ae_src, leader_id)
 
     if comp:
@@ -275,6 +294,22 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_a_match = out_a_match.astype(idt)  # bounded by the responder's log length
     out_a_hint = log_len.astype(idt)  # post-append, pre-injection (phase 6 rebinds)
 
+    # ---- phase 3.5: PreVote requests (thesis 9.6; raft.py) -----------------------
+    if cfg.pre_vote:
+        clock_pv = s.clock + inp.skew  # phase 7's clock; duplicated, CSE'd
+        heard = jnp.where(has_ae, clock_pv, s.heard_clock)  # [N, B]
+        is_pv = req_in & (mb.req_type == REQ_PREVOTE)[:, None, :]  # [cand, voter, B]
+        quiet = (clock_pv - heard >= cfg.election_min_ticks) & (role != LEADER)
+        pv_grant = (
+            is_pv
+            & (mb.req_term[:, None, :] >= term[None, :, :])
+            & up_to_date
+            & quiet[None, :, :]
+        )
+        pv_out = is_pv
+    else:
+        heard = s.heard_clock
+
     # ---- phase 4: responses ------------------------------------------------------
     vresp = resp_in & (mb.resp_kind == RESP_VOTE)
     new_votes = (
@@ -295,6 +330,21 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     len_i = log_len.astype(s.next_index.dtype)
     next_index = jnp.where(win[:, None, :], (len_i + 1)[:, None, :], s.next_index)
     match_index = jnp.where(win[:, None, :], 0, s.match_index)
+
+    # ---- phase 4.5: PreVote responses + promotion (thesis 9.6; raft.py) ----------
+    if cfg.pre_vote:
+        pvresp = resp_in & ((mb.resp_kind & 3) == RESP_PREVOTE)
+        new_pv = pvresp & (mb.resp_kind >= 4) & (role == PRECANDIDATE)[:, None, :]
+        votes = votes | new_pv
+        n_pv = jnp.sum(votes, axis=1).astype(jnp.int32)
+        pre_win = (role == PRECANDIDATE) & (n_pv >= cfg.quorum) & inp.alive
+        term = term + pre_win
+        role = jnp.where(pre_win, CANDIDATE, role)
+        voted_for = jnp.where(pre_win, ids2, voted_for)
+        pw = pre_win[:, None, :]
+        votes = (pw & eye3) | (~pw & votes)  # where-on-bools; see `grant` above
+    else:
+        pre_win = jnp.zeros_like(win)
 
     aresp = (
         resp_in
@@ -474,19 +524,33 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     reset_election = granted_any | has_ae | saw_higher
     deadline = jnp.where(reset_election, clock + inp.timeout_draw, s.deadline)
     deadline = jnp.where(win, clock + cfg.heartbeat_ticks, deadline)
+    if cfg.pre_vote:
+        deadline = jnp.where(pre_win, clock + inp.timeout_draw, deadline)
     expired = (clock >= deadline) & inp.alive
 
     heartbeat = expired & is_leader
     deadline = jnp.where(heartbeat, clock + cfg.heartbeat_ticks, deadline)
 
-    start_election = expired & ~is_leader
-    term = term + start_election
-    role = jnp.where(start_election, CANDIDATE, role)
-    voted_for = jnp.where(start_election, ids2, voted_for)
-    leader_id = jnp.where(start_election, NIL, leader_id)
-    se = start_election[:, None, :]
-    votes = (se & eye3) | (~se & votes)  # where-on-bools; see `grant` above
-    deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
+    if cfg.pre_vote:
+        # Expiry starts a PRE-vote probe: no term bump, votedFor untouched
+        # (raft.py phase 7); real elections start at promotions (phase 4.5).
+        start_prevote = expired & ~is_leader
+        role = jnp.where(start_prevote, PRECANDIDATE, role)
+        leader_id = jnp.where(start_prevote, NIL, leader_id)
+        sp = start_prevote[:, None, :]
+        votes = (sp & eye3) | (~sp & votes)
+        deadline = jnp.where(start_prevote, clock + inp.timeout_draw, deadline)
+        start_election = pre_win
+    else:
+        start_prevote = jnp.zeros_like(expired)
+        start_election = expired & ~is_leader
+        term = term + start_election
+        role = jnp.where(start_election, CANDIDATE, role)
+        voted_for = jnp.where(start_election, ids2, voted_for)
+        leader_id = jnp.where(start_election, NIL, leader_id)
+        se = start_election[:, None, :]
+        votes = (se & eye3) | (~se & votes)  # where-on-bools; see `grant` above
+        deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
 
     # ---- phase 8: outbox ---------------------------------------------------------
     send_append = win | heartbeat
@@ -502,6 +566,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_req_type = jnp.where(
         start_election, REQ_VOTE, jnp.where(send_append, REQ_APPEND, 0)
     )  # [N, B]
+    if cfg.pre_vote:
+        out_req_type = jnp.where(start_prevote, REQ_PREVOTE, out_req_type)
+        rv_like = start_election | start_prevote
+    else:
+        rv_like = start_election
+    out_req_term = jnp.where(out_req_type != 0, term, 0)
+    if cfg.pre_vote:
+        out_req_term = jnp.where(start_prevote, term + 1, out_req_term)  # prospective
     prev_out = jnp.clip(next_index - 1, 0, len_i[:, None, :])  # [src, dst, B]
     # Shared window start: minimum prev over RESPONSIVE peers, falling back to all
     # peers when none are (see raft.py phase 8 for the liveness argument).
@@ -555,6 +627,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_resp_kind = (
         jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
     ).astype(jnp.int8)
+    if cfg.pre_vote:
+        # kind = RESP_PREVOTE | granted << 2, per edge (raft.py phase 8).
+        out_resp_kind = out_resp_kind + (
+            jnp.where(pv_out, RESP_PREVOTE, 0) + jnp.where(pv_grant, 4, 0)
+        ).astype(jnp.int8)
     if comp:
         pterm = log_ops.term_at_rb(log_term_arr, base, bterm, ws)
     else:
@@ -562,10 +639,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
 
     new_mb = Mailbox(
         req_type=out_req_type,
-        req_term=jnp.where(out_req_type != 0, term, 0),
+        req_term=out_req_term,
         req_commit=jnp.where(send_append, commit, 0),
-        req_last_index=jnp.where(start_election, new_last_idx, 0),
-        req_last_term=jnp.where(start_election, new_last_term, 0),
+        req_last_index=jnp.where(rv_like, new_last_idx, 0),
+        req_last_term=jnp.where(rv_like, new_last_term, 0),
         ent_start=jnp.where(send_append, ws.astype(jnp.int32), 0),
         ent_prev_term=jnp.where(send_append, pterm, 0),
         ent_count=jnp.where(send_append, n_ship, 0),
@@ -617,6 +694,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         log_len=log_len,
         clock=clock,
         deadline=deadline,
+        heard_clock=heard,
         client_pend=client_pend,
         client_dst=client_dst,
         lat_frontier=lat_frontier,

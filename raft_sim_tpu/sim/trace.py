@@ -15,9 +15,14 @@ from typing import Iterator
 
 import numpy as np
 
-from raft_sim_tpu.types import CANDIDATE, FOLLOWER, LEADER, NIL
+from raft_sim_tpu.types import CANDIDATE, FOLLOWER, LEADER, NIL, PRECANDIDATE
 
-ROLE_NAMES = {FOLLOWER: "follower", CANDIDATE: "candidate", LEADER: "leader"}
+ROLE_NAMES = {
+    FOLLOWER: "follower",
+    CANDIDATE: "candidate",
+    LEADER: "leader",
+    PRECANDIDATE: "precandidate",
+}
 
 
 def info_lines(infos, every: int = 1) -> Iterator[str]:

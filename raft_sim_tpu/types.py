@@ -37,16 +37,28 @@ from raft_sim_tpu.utils.rng import draw_timeouts
 FOLLOWER = 0
 CANDIDATE = 1
 LEADER = 2
+# PreVote probe state (cfg.pre_vote; Raft thesis 9.6 -- BEYOND the reference,
+# which has no pre-vote, SURVEY.md 2.3.12): an expired node probes a majority
+# at its PROSPECTIVE next term before bumping its real term, so a partitioned
+# node cannot inflate its term and depose a stable leader on rejoin.
+PRECANDIDATE = 3
 
-# Request mailbox record types (reference URI routing, server.clj:8-12).
+# Request mailbox record types (reference URI routing, server.clj:8-12;
+# REQ_PREVOTE extends the set -- see PRECANDIDATE above).
 REQ_NONE = 0
 REQ_VOTE = 1  # :request-vote
 REQ_APPEND = 2  # :append-entries
+REQ_PREVOTE = 3  # pre-vote probe (carries the prospective term = sender term + 1)
 
-# Response mailbox record types (client.clj:8-9 keywordizes :type from the HTTP body).
+# Response mailbox record types (client.clj:8-9 keywordizes :type from the HTTP
+# body). A pre-vote response's GRANT rides bit 2 of the int8 resp_kind plane
+# (kind = RESP_PREVOTE | granted << 2): unlike real votes, one responder may
+# grant SEVERAL pre-candidates per tick (grants are non-binding and consume no
+# votedFor), so the grant cannot ride the per-responder v_to field.
 RESP_NONE = 0
 RESP_VOTE = 1  # :vote-response
 RESP_APPEND = 2  # :append-response
+RESP_PREVOTE = 3  # pre-vote response; granted = resp_kind >> 2
 
 NIL = -1  # nil node id
 
@@ -211,6 +223,12 @@ class ClusterState(NamedTuple):
     log_len: jax.Array  # [N] int32
     clock: jax.Array  # [N] int32 local (skewable) clock
     deadline: jax.Array  # [N] int32 next timer fire on the local clock
+    # Local-clock stamp of the last valid leader contact (accepted current-term
+    # AppendEntries), driving the thesis-9.6 pre-vote denial rule: a voter
+    # denies pre-votes while it heard from a leader within the minimum election
+    # timeout. Volatile (restart resets it to "long quiet"). Maintained only
+    # when cfg.pre_vote; untouched (loop-invariant) otherwise.
+    heard_clock: jax.Array  # [N] int32
     # Client-side state (cfg.client_redirect; NIL/0 otherwise): up to K =
     # cfg.client_pipeline commands the simulated client has in flight and the
     # node each one's next POST targets -- the array form of the reference
@@ -342,6 +360,8 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         log_len=jnp.zeros((n,), jnp.int32),
         clock=jnp.zeros((n,), jnp.int32),
         deadline=deadline,
+        # "Quiet since before time began": pre-votes are grantable at boot.
+        heard_clock=jnp.full((n,), -cfg.election_min_ticks, jnp.int32),
         client_pend=jnp.full((cfg.client_pipeline,), NIL, jnp.int32),
         client_dst=jnp.zeros((cfg.client_pipeline,), jnp.int32),
         lat_frontier=jnp.int32(0),

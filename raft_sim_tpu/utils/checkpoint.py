@@ -52,7 +52,9 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      histogram), noop_blocked, and lm_skipped_pairs.
 # v15: K-deep client pipeline -- client_pend/client_dst became [K] vectors
 #      (cfg.client_pipeline slots).
-_FORMAT_VERSION = 15
+# v16: PreVote (cfg.pre_vote) -- ClusterState gained heard_clock (last leader
+#      contact, driving the thesis-9.6 pre-vote denial rule).
+_FORMAT_VERSION = 16
 
 
 def _normalize(path: str) -> str:

@@ -129,6 +129,16 @@ class RaftConfig:
     # slot first. 1 = the round-4 single-command client.
     client_pipeline: int = 1
 
+    # PreVote (Raft thesis 9.6; BEYOND the reference, which has neither
+    # pre-vote nor leadership transfer -- SURVEY.md 2.3.12). When True, an
+    # expired node becomes a PRECANDIDATE and probes a majority at its
+    # prospective next term WITHOUT bumping its real term; only a pre-quorum
+    # promotes it to a real candidate. Voters deny the probe while they heard
+    # from a leader within the minimum election timeout, so a node partitioned
+    # away cannot inflate its term and depose a stable leader when the
+    # partition heals.
+    pre_vote: bool = False
+
     # On-device safety checking (north star: invariants checked every tick)
     check_invariants: bool = True
     # Log-matching check is O(N^2 * CAP) per tick -- gate separately.

@@ -19,8 +19,9 @@ from __future__ import annotations
 import numpy as np
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
-REQ_NONE, REQ_VOTE, REQ_APPEND = 0, 1, 2
-RESP_NONE, RESP_VOTE, RESP_APPEND = 0, 1, 2
+PRECANDIDATE = 3  # cfg.pre_vote probe state (thesis 9.6)
+REQ_NONE, REQ_VOTE, REQ_APPEND, REQ_PREVOTE = 0, 1, 2, 3
+RESP_NONE, RESP_VOTE, RESP_APPEND, RESP_PREVOTE = 0, 1, 2, 3
 NIL = -1
 # Independently-stated copies of the implementation's constants (the oracle must not
 # import from raft_sim_tpu); tests/test_constants.py pins them against the originals
@@ -92,6 +93,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     log_val = s["log_val"].copy()
     log_len = s["log_len"].copy()
     deadline = s["deadline"].copy()
+    heard_clock = s["heard_clock"].copy()
 
     alive = np.asarray(inp["alive"], bool)
     restarted = np.asarray(inp["restarted"], bool)
@@ -109,6 +111,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             commit[d] = log_base[d]
             commit_chk[d] = base_chk[d]
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
+            if cfg.pre_vote:
+                # a restarted node remembers no leader contact
+                heard_clock[d] = int(s["clock"][d]) - cfg.election_min_ticks
 
     # ---- phase 0: delivery
     # Input mask is per physical edge [to, from]; request headers are per sender
@@ -126,7 +131,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     for d in range(n):
         in_term = 0
         for src in range(n):
-            if req_in[src, d]:
+            # a PreVote probe's term is prospective: never adopted
+            if req_in[src, d] and mb["req_type"][src] != REQ_PREVOTE:
                 in_term = max(in_term, int(mb["req_term"][src]))
             if resp_in[d, src]:
                 in_term = max(in_term, int(mb["resp_term"][src]))
@@ -196,7 +202,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             continue
         src = min(cur_term)
         has_ae[d] = True
-        if role[d] == CANDIDATE:
+        if role[d] == CANDIDATE or (cfg.pre_vote and role[d] == PRECANDIDATE):
             role[d] = FOLLOWER
         leader_id[d] = src
 
@@ -275,6 +281,37 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # phase 3). Per responder: the same hint toward every nacked sender.
     a_hint = log_len.astype(np.int32).copy()
 
+    # ---- phase 3.5: PreVote requests (thesis 9.6; raft.py): grant iff the
+    # probe's prospective term is not behind us, its log is up to date, and we
+    # are quiet (not leader, no valid AE within the minimum election timeout).
+    pv_out = np.zeros((n, n), bool)
+    pv_grant = np.zeros((n, n), bool)
+    if cfg.pre_vote:
+        for d in range(n):
+            clock_pv = int(s["clock"][d]) + int(inp["skew"][d])
+            if has_ae[d]:
+                heard_clock[d] = clock_pv
+            quiet = (
+                clock_pv - int(heard_clock[d]) >= cfg.election_min_ticks
+                and role[d] != LEADER
+            )
+            my_last_idx = int(s["log_len"][d])
+            my_last_term = term_at_ring(
+                s["log_term"][d], int(s["log_base"][d]), int(s["base_term"][d]),
+                my_last_idx,
+            )
+            for src in range(n):
+                if not (req_in[src, d] and mb["req_type"][src] == REQ_PREVOTE):
+                    continue
+                pv_out[d, src] = True
+                c_idx = int(mb["req_last_index"][src])
+                c_term = int(mb["req_last_term"][src])
+                up = c_term > my_last_term or (
+                    c_term == my_last_term and c_idx >= my_last_idx
+                )
+                if quiet and up and int(mb["req_term"][src]) >= int(term[d]):
+                    pv_grant[d, src] = True
+
     # ---- phase 4: responses
     # Everyone's ack age grows one tick (saturating); stamps below zero it.
     ack_age = np.minimum(ack_age + 1, ACK_AGE_SAT).astype(ack_age.dtype)
@@ -297,6 +334,27 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             next_index[d, :] = log_len[d] + 1
             match_index[d, :] = 0
             ack_age[d, :] = 0  # grace-zero every peer (see raft.py phase 4)
+
+    # ---- phase 4.5: PreVote responses + promotion (thesis 9.6; raft.py)
+    pre_win = np.zeros(n, bool)
+    if cfg.pre_vote:
+        for d in range(n):
+            if role[d] != PRECANDIDATE:
+                continue
+            for src in range(n):
+                if (
+                    resp_in[d, src]
+                    and (int(mb["resp_kind"][d, src]) & 3) == RESP_PREVOTE
+                    and int(mb["resp_kind"][d, src]) >= 4
+                ):
+                    votes[d, src] = True
+            if int(votes[d].sum()) >= cfg.quorum and alive[d]:
+                pre_win[d] = True
+                term[d] += 1
+                role[d] = CANDIDATE
+                voted_for[d] = d
+                votes[d, :] = False
+                votes[d, d] = True
     for d in range(n):
         if role[d] != LEADER:
             continue
@@ -429,15 +487,26 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     clock = s["clock"] + np.asarray(inp["skew"], np.int32)
     heartbeat = np.zeros(n, bool)
     start_election = np.zeros(n, bool)
+    start_prevote = np.zeros(n, bool)
     for d in range(n):
         if granted_any[d] or has_ae[d] or saw_higher[d]:
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
         if win[d]:
             deadline[d] = clock[d] + cfg.heartbeat_ticks
+        if cfg.pre_vote and pre_win[d]:
+            deadline[d] = clock[d] + int(inp["timeout_draw"][d])
         expired = clock[d] >= deadline[d] and alive[d]
         if expired and role[d] == LEADER:
             heartbeat[d] = True
             deadline[d] = clock[d] + cfg.heartbeat_ticks
+        elif expired and cfg.pre_vote:
+            # expiry starts a PRE-vote probe: no term bump, votedFor untouched
+            start_prevote[d] = True
+            role[d] = PRECANDIDATE
+            leader_id[d] = NIL
+            votes[d, :] = False
+            votes[d, d] = True
+            deadline[d] = clock[d] + int(inp["timeout_draw"][d])
         elif expired:
             start_election[d] = True
             term[d] += 1
@@ -447,6 +516,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, :] = False
             votes[d, d] = True
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
+    if cfg.pre_vote:
+        # real RequestVote broadcasts come from this tick's promotions
+        start_election = pre_win
 
     # ---- phase 8: outbox (wire format v8: per-sender headers + per-edge offsets)
     z = lambda *shape: np.zeros(shape, np.int32)
@@ -480,6 +552,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             last_idx = int(log_len[src])
             out["req_type"][src] = REQ_VOTE
             out["req_term"][src] = term[src]
+            out["req_last_index"][src] = last_idx
+            out["req_last_term"][src] = term_at_ring(log_term[src], b, bt, last_idx)
+        elif cfg.pre_vote and start_prevote[src]:
+            last_idx = int(log_len[src])
+            out["req_type"][src] = REQ_PREVOTE
+            out["req_term"][src] = term[src] + 1  # prospective (thesis 9.6)
             out["req_last_index"][src] = last_idx
             out["req_last_term"][src] = term_at_ring(log_term[src], b, bt, last_idx)
         elif win[src] or heartbeat[src]:
@@ -533,6 +611,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 rtype += RESP_VOTE
             if ar_out[r, q]:
                 rtype += RESP_APPEND
+            if pv_out[r, q]:
+                rtype += RESP_PREVOTE + (4 if pv_grant[r, q] else 0)
             out["resp_kind"][q, r] = rtype
 
     # Monotone commit-latency frontier (types.ClusterState.lat_frontier):
@@ -561,6 +641,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "log_len": log_len,
         "clock": clock,
         "deadline": deadline,
+        "heard_clock": heard_clock,
         "client_pend": np.asarray(client_pend, np.int32),
         "client_dst": np.asarray(client_dst, np.int32),
         "lat_frontier": np.int32(lat_frontier),

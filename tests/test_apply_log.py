@@ -41,9 +41,11 @@ def test_export_matches_offered_schedule_across_compaction(tmp_path):
         # committed log of a healthy cluster is an offer-ordered subsequence).
         assert set(vals) <= offered
         assert vals == sorted(vals)
-        # The export is complete up to this node's commit frontier: count of
-        # client values = commit minus the no-ops in (0, commit].
-        assert len(vals) == len(set(vals))
+        # COMPLETE, not merely ordered: on a reliable single-leader run every
+        # offer between the first and last exported value was accepted and
+        # committed, so the stream must be exactly that contiguous slice of the
+        # schedule -- a silently dropped value would leave a hole here.
+        assert vals == sorted(v for v in offered if vals[0] <= v <= vals[-1])
     # Reliable net: all nodes export the SAME stream (log matching made
     # observable on the host) up to the shortest frontier.
     streams = [w.values(i) for i in range(CFG.n_nodes)]

@@ -82,6 +82,19 @@ CONFIGS = [
         ),
         id="n5-redirect-pipeline",  # K = 4 in-flight slots ([K, B] client state)
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=3,
+            pre_vote=True,
+            drop_prob=0.25,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        id="n5-prevote",  # thesis-9.6 probe rounds under churn
+    ),
 ]
 
 

@@ -154,6 +154,21 @@ CONFIGS = [
         # churn, per-node lowest-slot acceptance, parallel accepts at
         # split-brain leaders, per-slot bounce draws
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=3,
+            pre_vote=True,
+            drop_prob=0.25,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        11,
+        id="n5-prevote",  # thesis-9.6 probes under churn: precandidate rounds,
+        # per-edge grant bits, promotions, prospective-term non-adoption
+    ),
 ]
 
 
