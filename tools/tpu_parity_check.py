@@ -50,8 +50,9 @@ CONFIGS = {
         8,
         200,
     ),
-    # Ring compaction + snapshot catch-up + the 302-redirect client path: wide
-    # (int32) index planes, absolute-index checksums, routing state.
+    # Ring compaction + snapshot catch-up + the 302-redirect client path with a
+    # K-deep in-flight pipeline: wide (int32) index planes, absolute-index
+    # checksums, [K] routing state.
     "compaction+redirect": (
         dict(
             n_nodes=5,
@@ -60,6 +61,7 @@ CONFIGS = {
             max_entries_per_rpc=4,
             client_interval=2,
             client_redirect=True,
+            client_pipeline=3,
             drop_prob=0.15,
             crash_prob=0.3,
             crash_period=32,
@@ -68,6 +70,23 @@ CONFIGS = {
         11,
         32,
         500,
+    ),
+    # PreVote probe rounds under churn (round 5): prospective-term wire fields,
+    # per-edge grant bits in resp_kind, heard_clock arithmetic.
+    "prevote-churn": (
+        dict(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=3,
+            pre_vote=True,
+            drop_prob=0.25,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        13,
+        32,
+        400,
     ),
 }
 
