@@ -56,7 +56,6 @@ import jax.numpy as jnp
 
 from raft_sim_tpu.ops import log_ops
 from raft_sim_tpu.types import (
-    ACK_AGE_SAT,
     CANDIDATE,
     FOLLOWER,
     LAT_HIST_BINS,
@@ -102,7 +101,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         votes=s.votes & ~rs[:, None],
         next_index=jnp.where(rs[:, None], 1, s.next_index),
         match_index=jnp.where(rs[:, None], 0, s.match_index),
-        ack_age=jnp.where(rs[:, None], ACK_AGE_SAT, s.ack_age),
+        ack_age=jnp.where(rs[:, None], cfg.ack_age_sat, s.ack_age),
         commit_index=jnp.where(rs, s.log_base, s.commit_index),
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
@@ -434,7 +433,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # tick (saturating); any AE response (success or failure) proves the peer is up
     # and zeroes its age, and a fresh win grace-zeroes every peer so the first
     # window covers all of them.
-    ack_age = jnp.minimum(s.ack_age + 1, ACK_AGE_SAT)
+    ack_age = jnp.minimum(s.ack_age + 1, cfg.ack_age_sat)
     ack_age = jnp.where(win[:, None] | aresp, 0, ack_age)
 
     # ---- phase 5: leader commit advancement (absent in reference, bug 2.3.8) ------

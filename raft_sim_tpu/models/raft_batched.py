@@ -21,7 +21,6 @@ import jax.numpy as jnp
 
 from raft_sim_tpu.ops import log_ops
 from raft_sim_tpu.types import (
-    ACK_AGE_SAT,
     CANDIDATE,
     FOLLOWER,
     LAT_HIST_BINS,
@@ -79,7 +78,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         votes=s.votes & ~rs2,
         next_index=jnp.where(rs2, 1, s.next_index),
         match_index=jnp.where(rs2, 0, s.match_index),
-        ack_age=jnp.where(rs2, ACK_AGE_SAT, s.ack_age),
+        ack_age=jnp.where(rs2, cfg.ack_age_sat, s.ack_age),
         commit_index=jnp.where(rs, s.log_base, s.commit_index),
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
@@ -364,7 +363,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         a_fail, jnp.maximum(jnp.minimum(next_index - 1, ah + 1), 1), next_index
     )
     # Responsiveness ages for the shared-window filter (phase 8; see raft.py).
-    ack_age = jnp.minimum(s.ack_age + 1, ACK_AGE_SAT)
+    ack_age = jnp.minimum(s.ack_age + 1, cfg.ack_age_sat)
     ack_age = jnp.where(win[:, None, :] | aresp, 0, ack_age)
 
     # ---- phase 5: leader commit advancement --------------------------------------

@@ -27,9 +27,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-# ACK_AGE_SAT is re-exported here because the kernels read it alongside
-# ClusterState; it lives in config (the leaf module) for the validator.
-from raft_sim_tpu.utils.config import ACK_AGE_SAT, MAX_LOG_CAPACITY, RaftConfig
+# ACK_AGE_SAT* are re-exported here because state builders read them alongside
+# ClusterState; they live in config (the leaf module) for the validator.
+from raft_sim_tpu.utils.config import (
+    ACK_AGE_SAT,
+    ACK_AGE_SAT_NARROW,
+    MAX_LOG_CAPACITY,
+    RaftConfig,
+)
 from raft_sim_tpu.utils.rng import draw_timeouts
 
 # Node roles (reference keywords :follower/:candidate/:leader, core.clj:31-38;
@@ -80,6 +85,12 @@ NOOP = -2
 MAX_INT8_LOG_CAPACITY = 41
 assert 3 * MAX_INT8_LOG_CAPACITY + 2 <= 127  # int8 tier
 assert 3 * MAX_LOG_CAPACITY + 2 <= 32767  # int16 tier (utils/config.py ceiling)
+
+
+def ack_dtype(cfg: RaftConfig):
+    """Dtype of the ack-age plane: int8 whenever the saturation ceiling fits it
+    (cfg.ack_age_sat; +1 per tick before the clamp stays within range)."""
+    return jnp.int8 if cfg.ack_age_sat < 127 else jnp.int16
 
 
 def index_dtype(cfg: RaftConfig):
@@ -194,11 +205,12 @@ class ClusterState(NamedTuple):
     next_index: jax.Array  # [N, N] index_dtype; leader i's next index for peer j
     match_index: jax.Array  # [N, N] index_dtype
     # Ticks since leader i last received an AppendEntries response (success OR
-    # failure -- both prove the peer is up) from peer j, saturating at ACK_AGE_SAT;
+    # failure -- both prove the peer is up) from peer j, saturating at
+    # cfg.ack_age_sat (int8 plane whenever that ceiling fits -- ack_dtype);
     # zeroed for the whole row when i wins an election (grace period). Volatile
     # leader bookkeeping like next/match; drives the shared-entry-window
     # responsiveness filter (config.ack_timeout_ticks).
-    ack_age: jax.Array  # [N, N] int16
+    ack_age: jax.Array  # [N, N] ack_dtype (int8/int16)
     commit_index: jax.Array  # [N] int32
     # Weighted checksum of the committed prefix (log_ops.chk_weights), maintained
     # when config.check_invariants: the "committed entries are immutable" invariant
@@ -349,7 +361,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         votes=jnp.zeros((n, n), bool),
         next_index=jnp.ones((n, n), idt),
         match_index=jnp.zeros((n, n), idt),
-        ack_age=jnp.full((n, n), ACK_AGE_SAT, jnp.int16),
+        ack_age=jnp.full((n, n), cfg.ack_age_sat, ack_dtype(cfg)),
         commit_index=jnp.zeros((n,), jnp.int32),
         commit_chk=jnp.zeros((n,), jnp.uint32),
         log_base=jnp.zeros((n,), jnp.int32),

@@ -54,7 +54,9 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      (cfg.client_pipeline slots).
 # v16: PreVote (cfg.pre_vote) -- ClusterState gained heard_clock (last leader
 #      contact, driving the thesis-9.6 pre-vote denial rule).
-_FORMAT_VERSION = 16
+# v17: int8 ack-age plane (saturation at the narrow ceiling whenever the
+#      responsiveness horizon fits under it).
+_FORMAT_VERSION = 17
 
 
 def _normalize(path: str) -> str:

@@ -16,11 +16,16 @@ from __future__ import annotations
 
 import dataclasses
 
-# Saturation ceiling for ClusterState.ack_age (ticks since a peer's last
-# AppendEntries ack; re-exported by types.py). Ages cap here instead of growing
-# without bound so the field fits int16 on arbitrarily long runs; __post_init__
-# asserts ack_timeout_ticks stays below it. Lives here (not types.py) because the
+# Saturation ceilings for ClusterState.ack_age (ticks since a peer's last
+# AppendEntries ack; re-exported by types.py). Ages cap instead of growing
+# without bound so the field fits a narrow dtype on arbitrarily long runs: int8
+# saturating at 120 when ack_timeout_ticks fits under it (every preset does --
+# the timeout is a small multiple of the heartbeat), else int16 at 30000.
+# Saturation only has to exceed the timeout: every consumer tests
+# `age <= ack_timeout_ticks`, so trajectories are identical at either ceiling
+# (only the saturated VALUES differ). Lives here (not types.py) because the
 # config validator needs it and config is the leaf module.
+ACK_AGE_SAT_NARROW = 120
 ACK_AGE_SAT = 30000
 
 # Upper bound on RaftConfig.log_capacity. Log indices ride int16 state planes
@@ -185,6 +190,16 @@ class RaftConfig:
     def compaction(self) -> bool:
         """True when the ring-log compaction path is active (compact_margin > 0)."""
         return self.compact_margin > 0
+
+    @property
+    def ack_age_sat(self) -> int:
+        """Saturation ceiling for the ack-age plane: the int8 ceiling whenever
+        the responsiveness horizon fits under it (see ACK_AGE_SAT_NARROW)."""
+        return (
+            ACK_AGE_SAT_NARROW
+            if self.ack_timeout_ticks < ACK_AGE_SAT_NARROW
+            else ACK_AGE_SAT
+        )
 
     @property
     def quorum(self) -> int:

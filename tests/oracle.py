@@ -26,8 +26,18 @@ NIL = -1
 # Independently-stated copies of the implementation's constants (the oracle must not
 # import from raft_sim_tpu); tests/test_constants.py pins them against the originals
 # so they cannot drift silently.
-ACK_AGE_SAT = 30000  # raft_sim_tpu.utils.config.ACK_AGE_SAT
+# raft_sim_tpu.utils.config ACK_AGE_SAT / ACK_AGE_SAT_NARROW + the ack_age_sat
+# property, restated: ages saturate at the int8 ceiling when the responsiveness
+# horizon fits under it, else at the int16 ceiling.
+ACK_AGE_SAT = 30000
+ACK_AGE_SAT_NARROW = 120
 NOOP = -2  # raft_sim_tpu.types.NOOP (leader no-op entry value, compaction only)
+
+
+def ack_age_sat(cfg) -> int:
+    if cfg.ack_timeout_ticks < ACK_AGE_SAT_NARROW:
+        return ACK_AGE_SAT_NARROW
+    return ACK_AGE_SAT
 
 
 def chk_weights(k: int) -> tuple[int, int]:
@@ -107,7 +117,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, :] = False
             next_index[d, :] = 1
             match_index[d, :] = 0
-            ack_age[d, :] = ACK_AGE_SAT
+            ack_age[d, :] = ack_age_sat(cfg)
             commit[d] = log_base[d]
             commit_chk[d] = base_chk[d]
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
@@ -314,7 +324,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
 
     # ---- phase 4: responses
     # Everyone's ack age grows one tick (saturating); stamps below zero it.
-    ack_age = np.minimum(ack_age + 1, ACK_AGE_SAT).astype(ack_age.dtype)
+    ack_age = np.minimum(ack_age + 1, ack_age_sat(cfg)).astype(ack_age.dtype)
     for d in range(n):
         for src in range(n):
             if (

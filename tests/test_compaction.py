@@ -104,7 +104,7 @@ def leader(s, node, term, next_to=None):
         next_index=s.next_index.at[node].set(
             jnp.full((n,), nxt, s.next_index.dtype)
         ),
-        ack_age=s.ack_age.at[node].set(jnp.zeros((n,), jnp.int16)),
+        ack_age=s.ack_age.at[node].set(jnp.zeros((n,), s.ack_age.dtype)),
     )
 
 

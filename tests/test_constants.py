@@ -14,6 +14,14 @@ from tests import oracle
 
 def test_ack_age_sat_matches():
     assert oracle.ACK_AGE_SAT == config.ACK_AGE_SAT == types.ACK_AGE_SAT
+    assert oracle.ACK_AGE_SAT_NARROW == config.ACK_AGE_SAT_NARROW == types.ACK_AGE_SAT_NARROW
+    # The saturation-ceiling selection formula, restated by the oracle, must
+    # agree with the config property at both tiers.
+    from raft_sim_tpu.utils.config import RaftConfig
+
+    for timeout in (7, 12, 100, 119, 120, 500):
+        cfg = RaftConfig(ack_timeout_ticks=timeout)
+        assert oracle.ack_age_sat(cfg) == cfg.ack_age_sat
 
 
 def test_noop_sentinel_matches():

@@ -592,7 +592,7 @@ def test_window_fallback_when_no_peer_responsive():
         # Peer 1 is far behind (next=1 -> prev=0); everyone stale beyond the window.
         next_index=s.next_index.at[0, 1].set(1),
         ack_age=s.ack_age.at[0].set(
-            jnp.full((5,), CFG.ack_timeout_ticks + 5, jnp.int16)
+            jnp.full((5,), CFG.ack_timeout_ticks + 5, s.ack_age.dtype)
         ),
     )
     s2, _ = step(CFG, s)
@@ -610,7 +610,7 @@ def test_stale_peer_excluded_from_window_start():
     lifted to the window start."""
     s = with_log(base_state(), 0, [1, 1, 1])
     s = make_leader(s, 0, 1)
-    ages = jnp.zeros((5,), jnp.int16).at[1].set(CFG.ack_timeout_ticks + 5)
+    ages = jnp.zeros((5,), s.ack_age.dtype).at[1].set(CFG.ack_timeout_ticks + 5)
     s = s._replace(
         deadline=s.deadline.at[0].set(1),
         # Stale peer 1 is far behind; responsive peers 2-4 are at prev=2.
