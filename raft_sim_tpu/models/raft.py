@@ -54,7 +54,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from raft_sim_tpu.ops import log_ops
+from raft_sim_tpu.ops import bitplane, log_ops
 from raft_sim_tpu.types import (
     CANDIDATE,
     FOLLOWER,
@@ -83,6 +83,8 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
     ids = jnp.arange(n, dtype=jnp.int32)
     eye = jnp.eye(n, dtype=bool)
+    eye_p = bitplane.eye(n)  # [N, W] packed self-bit rows (votes plane layout)
+    zw = jnp.uint32(0)
     snd_ids = jnp.broadcast_to(ids[:, None], (n, n))  # [sender, receiver] -> sender id
 
     # ---- phase -1: restart (crash fault) -----------------------------------------
@@ -98,7 +100,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     s = s._replace(
         role=jnp.where(rs, FOLLOWER, s.role),
         leader_id=jnp.where(rs, NIL, s.leader_id),
-        votes=s.votes & ~rs[:, None],
+        votes=jnp.where(rs[:, None], zw, s.votes),
         next_index=jnp.where(rs[:, None], 1, s.next_index),
         match_index=jnp.where(rs[:, None], 0, s.match_index),
         ack_age=jnp.where(rs[:, None], cfg.ack_age_sat, s.ack_age),
@@ -123,13 +125,26 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # dies with it (the crashed process's sockets). Mailbox slots hold messages sent
     # last tick, so a node that just restarted must also not see them -- they were
     # addressed to a dead process (alive now & alive at send time = alive & ~restarted).
-    # The input mask is indexed by physical directed edge [to, from]; request fields
-    # are stored [sender, receiver] (= [from, to], Mailbox docstring) so requests
-    # read it transposed; response fields are [receiver, responder] (= [to, from])
-    # and read it directly.
+    # The input mask is indexed by physical directed edge [to, from] and arrives
+    # BIT-PACKED over the source axis (StepInputs docstring): the response-side
+    # chain ([receiver, responder] = [to, from], same orientation) runs on the
+    # packed words -- per-source gates AND as packed rows, per-receiver gates as
+    # row selects -- and unpacks once; request fields are stored
+    # [sender, receiver] (= [from, to], Mailbox docstring), so the request
+    # orientation unpacks the mask and transposes in bool space.
     dst_up = inp.alive & ~inp.restarted
-    deliver_req = inp.deliver_mask.T & ~eye & inp.alive[:, None] & dst_up[None, :]
-    deliver_resp = inp.deliver_mask & ~eye & dst_up[:, None] & inp.alive[None, :]
+    resp_del_p = jnp.where(
+        dst_up[:, None],
+        inp.deliver_mask & ~eye_p & bitplane.pack(inp.alive)[None, :],
+        zw,
+    )  # [N, W]; canonical (ANDed with the canonical input mask)
+    deliver_resp = bitplane.unpack(resp_del_p, n, axis=1)
+    deliver_req = (
+        bitplane.unpack(inp.deliver_mask, n, axis=1).T
+        & ~eye
+        & inp.alive[:, None]
+        & dst_up[None, :]
+    )
     req_in = deliver_req & (mb.req_type != 0)[:, None]  # [sender, receiver]
     resp_in = deliver_resp & (mb.resp_kind != 0)  # [receiver, responder]
 
@@ -151,7 +166,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     role = jnp.where(saw_higher, FOLLOWER, s.role)
     voted_for = jnp.where(saw_higher, NIL, s.voted_for)
     leader_id = jnp.where(saw_higher, NIL, s.leader_id)
-    votes = s.votes & ~saw_higher[:, None]
+    votes = jnp.where(saw_higher[:, None], zw, s.votes)
 
     if comp:
         my_last_idx = s.log_len
@@ -376,8 +391,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         & (mb.resp_term[None, :] == term[:, None])
         & (role == CANDIDATE)[:, None]
     )
-    votes = votes | new_votes
-    n_votes = jnp.sum(votes, axis=1).astype(jnp.int32)
+    votes = votes | bitplane.pack(new_votes, axis=1)
+    # Quorum test on the packed plane: word popcount instead of an [N, N]
+    # bool-plane sum (the bitplane module's reason to exist).
+    n_votes = bitplane.count(votes, axis=1)
     # A down candidate cannot assume leadership from votes banked before it crashed.
     win = (role == CANDIDATE) & (n_votes >= cfg.quorum) & inp.alive
     role = jnp.where(win, LEADER, role)
@@ -395,15 +412,22 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # it to a REAL candidate: only now does the term bump, the self-vote land,
     # and a real RequestVote broadcast go out (phase 8 via start_election).
     if cfg.pre_vote:
-        pvresp = resp_in & ((mb.resp_kind & 3) == RESP_PREVOTE)
-        new_pv = pvresp & (mb.resp_kind >= 4) & (role == PRECANDIDATE)[:, None]
+        # The grant bit rides the packed pv_grant plane (Mailbox docstring):
+        # AND the packed response-validity rows against it -- word algebra, no
+        # per-edge byte plane.
+        pvresp = resp_in & (mb.resp_kind == RESP_PREVOTE)
+        new_pv = jnp.where(
+            (role == PRECANDIDATE)[:, None],
+            bitplane.pack(pvresp, axis=1) & mb.pv_grant,
+            zw,
+        )
         votes = votes | new_pv
-        n_pv = jnp.sum(votes, axis=1).astype(jnp.int32)
+        n_pv = bitplane.count(votes, axis=1)
         pre_win = (role == PRECANDIDATE) & (n_pv >= cfg.quorum) & inp.alive
         term = term + pre_win
         role = jnp.where(pre_win, CANDIDATE, role)
         voted_for = jnp.where(pre_win, ids, voted_for)
-        votes = jnp.where(pre_win[:, None], eye, votes)
+        votes = jnp.where(pre_win[:, None], eye_p, votes)
     else:
         pre_win = jnp.zeros((n,), bool)
 
@@ -646,7 +670,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         start_prevote = expired & ~is_leader
         role = jnp.where(start_prevote, PRECANDIDATE, role)
         leader_id = jnp.where(start_prevote, NIL, leader_id)
-        votes = jnp.where(start_prevote[:, None], eye, votes)
+        votes = jnp.where(start_prevote[:, None], eye_p, votes)
         deadline = jnp.where(start_prevote, clock + inp.timeout_draw, deadline)
         start_election = pre_win
     else:
@@ -656,7 +680,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         role = jnp.where(start_election, CANDIDATE, role)
         voted_for = jnp.where(start_election, ids, voted_for)
         leader_id = jnp.where(start_election, NIL, leader_id)
-        votes = jnp.where(start_election[:, None], eye, votes)
+        votes = jnp.where(start_election[:, None], eye_p, votes)
         deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
 
     # ---- phase 8: outbox ---------------------------------------------------------
@@ -739,12 +763,15 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
     ).astype(jnp.int8)
     if cfg.pre_vote:
-        # Pre-vote responses overlay the same plane; the grant rides bit 2
-        # (kind = RESP_PREVOTE | granted << 2 -- per edge, since one voter may
-        # grant several probes per tick).
-        out_resp_kind = out_resp_kind + (
-            jnp.where(pv_out, RESP_PREVOTE, 0) + jnp.where(pv_grant, 4, 0)
-        ).astype(jnp.int8)
+        # Pre-vote responses overlay the same plane; the grant BIT rides the
+        # packed pv_grant plane (one voter may grant several probes per tick,
+        # so it is genuinely per-edge -- Mailbox docstring).
+        out_resp_kind = out_resp_kind + jnp.where(pv_out, RESP_PREVOTE, 0).astype(
+            jnp.int8
+        )
+        out_pv_grant = bitplane.pack(pv_grant, axis=1)  # [cand, W(bit=voter)]
+    else:
+        out_pv_grant = mb.pv_grant  # zeros, loop-invariant carry component
     pterm = (
         log_ops.term_at_r(log_term_arr, base, bterm, ws)
         if comp
@@ -771,6 +798,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         ),
         req_off=out_req_off,
         resp_kind=out_resp_kind,
+        pv_grant=out_pv_grant,
         v_to=grant_to,
         a_ok_to=out_a_ok_to,
         a_match=out_a_match,

@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from raft_sim_tpu.ops import log_ops
+from raft_sim_tpu.ops import bitplane, log_ops
 from raft_sim_tpu.types import (
     CANDIDATE,
     FOLLOWER,
@@ -66,6 +66,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     iota = log_ops.iota
     ids2 = iota((n, 1), 0)  # [N, 1] node id column
     eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)  # [N, N, 1]
+    eye_p3 = bitplane.eye(n)[:, :, None]  # [N, W, 1] packed self-bit rows
+    zw = jnp.uint32(0)
     snd_ids = iota((n, n, 1), 0)  # [sender, receiver, 1] -> sender id
 
     # ---- phase -1: restart (crash fault) -----------------------------------------
@@ -75,7 +77,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     s = s._replace(
         role=jnp.where(rs, FOLLOWER, s.role),
         leader_id=jnp.where(rs, NIL, s.leader_id),
-        votes=s.votes & ~rs2,
+        votes=jnp.where(rs2, zw, s.votes),
         next_index=jnp.where(rs2, 1, s.next_index),
         match_index=jnp.where(rs2, 0, s.match_index),
         ack_age=jnp.where(rs2, cfg.ack_age_sat, s.ack_age),
@@ -96,14 +98,22 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     # ---- phase 0: delivery -------------------------------------------------------
     # Input mask is per physical edge [to, from]; requests ([sender, receiver]) read
     # it transposed, responses ([receiver, responder]) directly (raft.py phase 0).
+    # The mask arrives bit-packed over the source axis (raft.py phase 0): the
+    # response orientation runs its AND-chain on the packed words and unpacks
+    # once; the request orientation unpacks and transposes in bool space.
     dst_up = inp.alive & ~inp.restarted  # alive now AND at send time (last tick)
+    resp_del_p = jnp.where(
+        dst_up[:, None, :],
+        inp.deliver_mask & ~eye_p3 & bitplane.pack(inp.alive, axis=0)[None, :, :],
+        zw,
+    )  # [N, W, B]
+    deliver_resp = bitplane.unpack(resp_del_p, n, axis=1)
     deliver_req = (
-        jnp.swapaxes(inp.deliver_mask, 0, 1)
+        jnp.swapaxes(bitplane.unpack(inp.deliver_mask, n, axis=1), 0, 1)
         & ~eye3
         & inp.alive[:, None, :]
         & dst_up[None, :, :]
     )  # [N, N, B]
-    deliver_resp = inp.deliver_mask & ~eye3 & dst_up[:, None, :] & inp.alive[None, :, :]
     req_in = deliver_req & (mb.req_type != 0)[:, None, :]
     resp_in = deliver_resp & (mb.resp_kind != 0)
 
@@ -122,7 +132,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     role = jnp.where(saw_higher, FOLLOWER, s.role)
     voted_for = jnp.where(saw_higher, NIL, s.voted_for)
     leader_id = jnp.where(saw_higher, NIL, s.leader_id)
-    votes = s.votes & ~saw_higher[:, None, :]
+    votes = jnp.where(saw_higher[:, None, :], zw, s.votes)
 
     if comp:
         my_last_idx = s.log_len
@@ -317,8 +327,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         & (mb.resp_term[None, :, :] == term[:, None, :])
         & (role == CANDIDATE)[:, None, :]
     )
-    votes = votes | new_votes
-    n_votes = jnp.sum(votes, axis=1).astype(jnp.int32)  # [N, B]
+    votes = votes | bitplane.pack(new_votes, axis=1)
+    # Packed-quorum test: word popcount over [N, W, B] instead of a bool-plane
+    # sum over [N, N, B] (raft.py phase 4).
+    n_votes = bitplane.count(votes, axis=1)  # [N, B]
     win = (role == CANDIDATE) & (n_votes >= cfg.quorum) & inp.alive
     role = jnp.where(win, LEADER, role)
     leader_id = jnp.where(win, ids2, leader_id)
@@ -332,16 +344,22 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
 
     # ---- phase 4.5: PreVote responses + promotion (thesis 9.6; raft.py) ----------
     if cfg.pre_vote:
-        pvresp = resp_in & ((mb.resp_kind & 3) == RESP_PREVOTE)
-        new_pv = pvresp & (mb.resp_kind >= 4) & (role == PRECANDIDATE)[:, None, :]
+        # Grant bits ride the packed pv_grant plane (raft.py phase 4.5).
+        pvresp = resp_in & (mb.resp_kind == RESP_PREVOTE)
+        new_pv = jnp.where(
+            (role == PRECANDIDATE)[:, None, :],
+            bitplane.pack(pvresp, axis=1) & mb.pv_grant,
+            zw,
+        )
         votes = votes | new_pv
-        n_pv = jnp.sum(votes, axis=1).astype(jnp.int32)
+        n_pv = bitplane.count(votes, axis=1)
         pre_win = (role == PRECANDIDATE) & (n_pv >= cfg.quorum) & inp.alive
         term = term + pre_win
         role = jnp.where(pre_win, CANDIDATE, role)
         voted_for = jnp.where(pre_win, ids2, voted_for)
-        pw = pre_win[:, None, :]
-        votes = (pw & eye3) | (~pw & votes)  # where-on-bools; see `grant` above
+        # votes is uint32 now: a plain select (the i1-select Mosaic caveat that
+        # forced boolean arithmetic here no longer applies to this plane).
+        votes = jnp.where(pre_win[:, None, :], eye_p3, votes)
     else:
         pre_win = jnp.zeros_like(win)
 
@@ -536,8 +554,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         start_prevote = expired & ~is_leader
         role = jnp.where(start_prevote, PRECANDIDATE, role)
         leader_id = jnp.where(start_prevote, NIL, leader_id)
-        sp = start_prevote[:, None, :]
-        votes = (sp & eye3) | (~sp & votes)
+        votes = jnp.where(start_prevote[:, None, :], eye_p3, votes)
         deadline = jnp.where(start_prevote, clock + inp.timeout_draw, deadline)
         start_election = pre_win
     else:
@@ -547,8 +564,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         role = jnp.where(start_election, CANDIDATE, role)
         voted_for = jnp.where(start_election, ids2, voted_for)
         leader_id = jnp.where(start_election, NIL, leader_id)
-        se = start_election[:, None, :]
-        votes = (se & eye3) | (~se & votes)  # where-on-bools; see `grant` above
+        votes = jnp.where(start_election[:, None, :], eye_p3, votes)
         deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
 
     # ---- phase 8: outbox ---------------------------------------------------------
@@ -627,10 +643,13 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         jnp.where(vr_out, RESP_VOTE, 0) + jnp.where(ar_out, RESP_APPEND, 0)
     ).astype(jnp.int8)
     if cfg.pre_vote:
-        # kind = RESP_PREVOTE | granted << 2, per edge (raft.py phase 8).
-        out_resp_kind = out_resp_kind + (
-            jnp.where(pv_out, RESP_PREVOTE, 0) + jnp.where(pv_grant, 4, 0)
-        ).astype(jnp.int8)
+        # The grant bit rides the packed pv_grant plane (raft.py phase 8).
+        out_resp_kind = out_resp_kind + jnp.where(pv_out, RESP_PREVOTE, 0).astype(
+            jnp.int8
+        )
+        out_pv_grant = bitplane.pack(pv_grant, axis=1)  # [cand, W(bit=voter), B]
+    else:
+        out_pv_grant = mb.pv_grant  # zeros, loop-invariant carry component
     if comp:
         pterm = log_ops.term_at_rb(log_term_arr, base, bterm, ws)
     else:
@@ -656,6 +675,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         ),
         req_off=out_req_off,
         resp_kind=out_resp_kind,
+        pv_grant=out_pv_grant,
         v_to=grant_to,
         a_ok_to=out_a_ok_to,
         a_match=out_a_match,

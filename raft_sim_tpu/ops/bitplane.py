@@ -1,0 +1,134 @@
+"""Packed boolean bit-planes: `[..., n, ...] bool` <-> `[..., W, ...] uint32`.
+
+The [N, N]-shaped boolean planes (ClusterState.votes, the fault-injection
+delivery mask, the pre-vote grant bits) ride one BYTE per bit in dense form and
+dominate the per-tick HBM traffic of wide clusters next to the int8 edge planes
+(types.Mailbox docstring; tools/traffic_audit.py accounts the exact bytes).
+This module packs such a plane 32 bits per uint32 word along one node axis:
+W = ceil(n / 32) words replace n bools (N=51 packs into 2 words).
+
+Conventions and invariants:
+
+  - Bit j of word w along the packed axis holds source index ``32*w + j``.
+  - All functions take an explicit ``axis`` (the node axis being packed or
+    unpacked) and work at ANY rank, so the same code serves the single-cluster
+    kernel ([N, N] -> [N, W], vmap-lifted) and the batch-minor hot path
+    ([N, N, B] -> [N, W, B]) -- shapes stay static, nothing gathers or
+    reshapes (iota + shift + masked reduce only, the constraint every op
+    shared with models/raft_batched.py observes -- see log_ops.iota).
+  - CANONICAL planes keep their padding bits (bit positions >= n in the last
+    word) ZERO. ``pack`` always produces canonical words, and `&`/`|` of
+    canonical words are canonical, so `popcount`-based quorum counts are exact.
+    The one operator that breaks canonicality is `~`: NOT a packed plane only
+    inside an AND with a canonical operand (``a & ~b``), never bare.
+
+Word-level boolean algebra is just the integer operators -- ``a & b``,
+``a | b``, ``a & ~b`` (andnot) -- which is the point: a 32-lane boolean op per
+instruction and an 8x (bool) to 32x (one-hot int32) denser memory footprint.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+WORD = 32
+
+
+def n_words(n: int) -> int:
+    """Words needed for an n-bit row: ceil(n / 32)."""
+    return -(-n // WORD)
+
+
+def _axis(a: int, ndim: int) -> int:
+    return a % ndim
+
+
+def pack(x: jax.Array, axis: int = -1) -> jax.Array:
+    """Pack bools along `axis` into uint32 words: shape n -> ceil(n/32) there.
+
+    Returns canonical words (padding bits zero). Works at any rank; vmap-safe.
+    """
+    ax = _axis(axis, x.ndim)
+    n = x.shape[ax]
+    w = n_words(n)
+    kshape = tuple(n if d == ax else 1 for d in range(x.ndim))
+    k = lax.broadcasted_iota(jnp.int32, kshape, ax)  # bit index along `axis`
+    xb = x.astype(jnp.uint32)
+    words = []
+    for wi in range(w):
+        sh = k - WORD * wi
+        valid = (sh >= 0) & (sh < WORD)
+        shifted = xb << jnp.where(valid, sh, 0).astype(jnp.uint32)
+        contrib = jnp.where(valid, shifted, jnp.uint32(0))
+        words.append(jnp.sum(contrib, axis=ax, keepdims=True, dtype=jnp.uint32))
+    return jnp.concatenate(words, axis=ax)
+
+
+def unpack(words: jax.Array, n: int, axis: int = -1) -> jax.Array:
+    """Inverse of `pack`: uint32 words along `axis` -> n bools there."""
+    ax = _axis(axis, words.ndim)
+    w = words.shape[ax]
+    assert w == n_words(n), f"{w} words cannot hold {n} bits"
+    oshape = tuple(n if d == ax else words.shape[d] for d in range(words.ndim))
+    kshape = tuple(n if d == ax else 1 for d in range(words.ndim))
+    k = lax.broadcasted_iota(jnp.int32, kshape, ax)
+    out = jnp.zeros(oshape, bool)
+    for wi in range(w):
+        word = lax.slice_in_dim(words, wi, wi + 1, axis=ax)
+        sh = k - WORD * wi
+        valid = (sh >= 0) & (sh < WORD)
+        bit = (word >> jnp.where(valid, sh, 0).astype(jnp.uint32)) & jnp.uint32(1)
+        out = out | (valid & (bit != 0))
+    return out
+
+
+def popcount(words: jax.Array) -> jax.Array:
+    """Per-word population count (uint32 in, uint32 out), elementwise."""
+    return lax.population_count(words)
+
+
+def count(words: jax.Array, axis: int = -1) -> jax.Array:
+    """Row popcount: total set bits along the word axis, int32.
+
+    The packed-quorum primitive: `count(votes, axis=word_axis) >= cfg.quorum`
+    replaces `jnp.sum(votes_bool, axis=node_axis) >= cfg.quorum`. Exact on
+    canonical planes (padding bits zero)."""
+    return jnp.sum(popcount(words).astype(jnp.int32), axis=_axis(axis, words.ndim))
+
+
+def andnot(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a & ~b. Canonical whenever `a` is canonical (the ~ never escapes the &)."""
+    return a & ~b
+
+
+def full_row(n: int) -> jax.Array:
+    """[W] uint32 with every VALID bit set -- the canonical all-true row (the
+    packed form's `jnp.ones((n,), bool)`)."""
+    return pack(jnp.ones((n,), bool))
+
+
+def bit_row(i: int, n: int) -> jax.Array:
+    """[W] uint32 with only bit `i` set (a packed one-hot row)."""
+    return pack(jnp.zeros((n,), bool).at[i].set(True))
+
+
+def eye(n: int) -> jax.Array:
+    """[N, W] packed identity: row i holds exactly bit i (the packed
+    `jnp.eye(n, dtype=bool)` -- a candidate's self-vote rows)."""
+    return pack(jnp.eye(n, dtype=bool), axis=1)
+
+
+def set_bit(plane: jax.Array, row, col, value: bool = True) -> jax.Array:
+    """Set (or clear) single bit `col` of `plane[row]` on a [N, W] packed plane.
+    Test/state-surgery helper; kernels use the word algebra directly."""
+    w, b = col // WORD, jnp.uint32(1 << (col % WORD))
+    word = plane[row, w]
+    new = (word | b) if value else (word & ~b)
+    return plane.at[row, w].set(new)
+
+
+def get_bit(plane: jax.Array, row, col) -> jax.Array:
+    """Test single bit `col` of `plane[row]` on a [N, W] packed plane -> bool."""
+    return (plane[row, col // WORD] >> (col % WORD)) & 1 != 0

@@ -31,6 +31,24 @@ from raft_sim_tpu.utils.config import RaftConfig
 AXIS = "clusters"
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """Version-portable shard_map: jax >= 0.6 exposes it top-level with the
+    varying-manual-axes check named `check_vma`; jax 0.4/0.5 (this image) has it
+    in jax.experimental with the same check named `check_rep`. The check is
+    disabled either way: the scan carry mixes axis-invariant constants
+    (init_metrics zeros) with per-cluster varying state, and the body has no
+    cross-device communication to validate."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
 def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -100,21 +118,28 @@ def simulate_sharded(cfg: RaftConfig, seed, batch: int, n_ticks: int, mesh: Mesh
     keys_init = jax.random.split(k_init, batch)
     keys_run = jax.random.split(k_run, batch)
 
-    # check_vma=False: the scan carry mixes axis-invariant constants (init_metrics
-    # zeros) with per-cluster varying state; there is no cross-device communication in
-    # the body, so the varying-manual-axes bookkeeping is disabled.
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         functools.partial(_run_shard, cfg, n_ticks),
         mesh=mesh,
         in_specs=(P(AXIS), P(AXIS)),
         out_specs=P(AXIS),
-        check_vma=False,
     )
-    keys_init = jax.lax.with_sharding_constraint(
-        keys_init, NamedSharding(mesh, P(AXIS))
-    )
-    keys_run = jax.lax.with_sharding_constraint(keys_run, NamedSharding(mesh, P(AXIS)))
+    keys_init = _constrain_keys(keys_init, mesh)
+    keys_run = _constrain_keys(keys_run, mesh)
     return sharded(keys_init, keys_run)
+
+
+def _constrain_keys(keys, mesh: Mesh):
+    """Batch-shard a typed PRNG key array. The constraint is applied to the raw
+    key DATA ([B, 2] uint32) and the keys re-wrapped: older jax (0.4.x) fails
+    to extend a rank-1 sharding spec over the key dtype's hidden trailing dim
+    ("tile assignment dimensions different than input rank" at compile time),
+    while the data route lowers identically on every supported version. Values
+    are untouched -- only placement metadata is attached."""
+    kd = jax.random.key_data(keys)
+    spec = P(AXIS, *([None] * (kd.ndim - 1)))
+    kd = jax.lax.with_sharding_constraint(kd, NamedSharding(mesh, spec))
+    return jax.random.wrap_key_data(kd)
 
 
 class FleetSummary(NamedTuple):
@@ -170,7 +195,15 @@ def gather_metrics(metrics):
 def _hist_percentile(hist, q: float) -> float | None:
     """The q-quantile latency from a summed log2-bin histogram: bin k holds
     latencies in [2^k, 2^(k+1)), linearly interpolated inside the hit bin.
-    None for an empty histogram."""
+    None for an empty histogram.
+
+    The interpolation assumes uniform spread inside the bin, which biases
+    upward by as much as the bin width; when the hit bin is the FIRST nonempty
+    one the quantile is clamped to the bin's lower edge instead -- an
+    all-1-tick run reports lat_p50 = 1.0, not 1.5 (the distribution's minimum
+    is a hard lower bound on every quantile, and with no mass below the bin
+    there is nothing to interpolate against). Tail granularity above the first
+    bin remains up to 2x -- inherent to log2 binning."""
     total = int(hist.sum())
     if total == 0:
         return None
@@ -179,6 +212,8 @@ def _hist_percentile(hist, q: float) -> float | None:
     for k, c in enumerate(int(x) for x in hist):
         if c and cum + c >= need:
             lo, hi = float(1 << k), float(1 << (k + 1))
+            if cum == 0:
+                return lo  # first nonempty bin: clamp to its lower edge
             return lo + (need - cum) / c * (hi - lo)
         cum += c
     return float(1 << len(hist))

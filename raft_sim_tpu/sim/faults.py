@@ -34,6 +34,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from raft_sim_tpu.ops import bitplane
 from raft_sim_tpu.types import NIL, StepInputs
 from raft_sim_tpu.utils.config import RaftConfig
 from raft_sim_tpu.utils.rng import draw_timeouts
@@ -141,7 +142,10 @@ def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
         restarted = jnp.zeros((n,), bool)
 
     return StepInputs(
-        deliver_mask=deliver,
+        # Shipped bit-packed over the source axis (StepInputs docstring): the
+        # same Bernoulli/partition draws, 32 edges per uint32 word -- the [N, N]
+        # bool plane never leaves this function.
+        deliver_mask=bitplane.pack(deliver, axis=1),
         skew=skew,
         timeout_draw=timeout_draw,
         client_cmd=client_cmd,

@@ -14,7 +14,11 @@ reference's two-tick RPC structure (SURVEY.md section 3.2).
 
 Integers default to int32; the [N, N]-shaped planes ride narrower types (int16 for
 log-index bookkeeping and ack ages, int8 for window offsets -- bounds asserted by
-RaftConfig) because they dominate HBM traffic at large N. Node ids are 0-based with
+RaftConfig) because they dominate HBM traffic at large N, and the purely BOOLEAN
+planes (the votes bitmap, the pre-vote grant bits, and the per-tick delivery mask)
+pack 32 bits per uint32 word along the source-node axis (ops/bitplane.py:
+[N, W = ceil(N/32)] words instead of [N, N] bytes; quorum checks become word
+popcounts). Node ids are 0-based with
 -1 as nil (the reference uses 1-based ids and `nil`, core.clj:31-38). Log indices are
 1-based counts like the reference/spec (entry i lives at array slot i-1; index 0
 means "no entry", log.clj:20-23).
@@ -29,6 +33,7 @@ import jax.numpy as jnp
 
 # ACK_AGE_SAT* are re-exported here because state builders read them alongside
 # ClusterState; they live in config (the leaf module) for the validator.
+from raft_sim_tpu.ops import bitplane
 from raft_sim_tpu.utils.config import (
     ACK_AGE_SAT,
     ACK_AGE_SAT_NARROW,
@@ -56,14 +61,14 @@ REQ_APPEND = 2  # :append-entries
 REQ_PREVOTE = 3  # pre-vote probe (carries the prospective term = sender term + 1)
 
 # Response mailbox record types (client.clj:8-9 keywordizes :type from the HTTP
-# body). A pre-vote response's GRANT rides bit 2 of the int8 resp_kind plane
-# (kind = RESP_PREVOTE | granted << 2): unlike real votes, one responder may
-# grant SEVERAL pre-candidates per tick (grants are non-binding and consume no
-# votedFor), so the grant cannot ride the per-responder v_to field.
+# body). A pre-vote response's GRANT rides the packed pv_grant bit-plane
+# (Mailbox.pv_grant): unlike real votes, one responder may grant SEVERAL
+# pre-candidates per tick (grants are non-binding and consume no votedFor), so
+# the grant cannot ride the per-responder v_to field.
 RESP_NONE = 0
 RESP_VOTE = 1  # :vote-response
 RESP_APPEND = 2  # :append-response
-RESP_PREVOTE = 3  # pre-vote response; granted = resp_kind >> 2
+RESP_PREVOTE = 3  # pre-vote response; the grant bit rides Mailbox.pv_grant
 
 NIL = -1  # nil node id
 
@@ -104,7 +109,8 @@ def index_dtype(cfg: RaftConfig):
 
 
 class Mailbox(NamedTuple):
-    """In-flight RPC state, one tick deep. TPU-native wire format, v9.
+    """In-flight RPC state, one tick deep. TPU-native wire format, v9 (+ the
+    round-6 packed pre-vote grant bit-plane, checkpoint v18).
 
     Both RPCs are logically broadcasts (the reference sends RequestVote and
     AppendEntries to every peer, core.clj:48-67), and after the shared-window prev
@@ -119,6 +125,14 @@ class Mailbox(NamedTuple):
       req_off:  [sender, receiver] -- AppendEntries per-edge window offset j.
       resp_kind: [receiver, responder] -- RESP_* type of the response on that
         edge; the response payload is per RESPONDER (below).
+      pv_grant: [receiver, W] -- the pre-vote grant BITS, bit-packed over the
+        responder axis (ops/bitplane.py; 32 responders per uint32 word). The
+        only genuinely boolean per-edge response datum: one voter may grant
+        several probing pre-candidates in the same tick, so the grant can ride
+        neither v_to nor the resp_kind value -- it used to occupy bit 2 of the
+        int8 resp_kind plane and now costs W words per receiver instead of a
+        byte per edge. All-zero (and carried untouched, so XLA sees a
+        loop-invariant component) unless cfg.pre_vote.
 
     AppendEntries reconstruction at receiver d from sender s (validated against the
     usual prev checks, so spec-equivalent to an explicit per-edge header):
@@ -173,6 +187,7 @@ class Mailbox(NamedTuple):
     req_base_chk: jax.Array  # [N] uint32: checksum of the compacted prefix
     req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E; -1 = snapshot
     resp_kind: jax.Array  # [N(receiver), N(responder)] int8 (RESP_*): response type per edge
+    pv_grant: jax.Array  # [N(receiver), W] uint32: packed pre-vote grant bits (bit = responder)
     v_to: jax.Array  # [N(responder)] int8: candidate granted this tick (NIL = none)
     a_ok_to: jax.Array  # [N(responder)] int8: AE sender acked OK this tick (NIL = none)
     a_match: jax.Array  # [N(responder)] int16/int32 (index_dtype): acked index of the successful append
@@ -185,7 +200,7 @@ class ClusterState(NamedTuple):
 
     Maps the reference node map + log atom (SURVEY.md section 2.2) onto arrays:
       role/term/voted_for/leader_id  <- :state/:current-term/:voted-for/:leader-id
-      votes [N,N] bool bitmap        <- :votes set (core.clj:38)
+      votes [N,W] packed bitmap      <- :votes set (core.clj:38)
       next_index/match_index [N,N]   <- :leader-state maps (core.clj:40-42)
       log_term/log_val/log_len       <- log atom :entries (log.clj:33)
       commit_index                   <- log atom :commit-index
@@ -196,7 +211,12 @@ class ClusterState(NamedTuple):
     term: jax.Array  # [N] int32 (starts at 1, core.clj:34)
     voted_for: jax.Array  # [N] int32 (NIL = none)
     leader_id: jax.Array  # [N] int32 (NIL = unknown)
-    votes: jax.Array  # [N, N] bool; votes[i, j] = i holds a granted vote from j
+    # Bit-packed votes bitmap (ops/bitplane.py): bit j of votes[i] set = node i
+    # holds a granted vote (or pre-vote grant, while PRECANDIDATE) from node j.
+    # The quorum test is a word popcount (bitplane.count >= cfg.quorum), and the
+    # plane costs W = ceil(N/32) uint32 words per node instead of N bool bytes
+    # (N=51: 2 words = 8 bytes vs 51 bytes carried per node per tick).
+    votes: jax.Array  # [N, W] uint32; bit j of votes[i] = i holds a vote from j
     # The three [N, N] leader-bookkeeping planes are the largest state after the
     # mailbox; log indices are capacity-bounded (int8 up to capacity 41, int16 up
     # to 4095 -- index_dtype) and ages saturate (ACK_AGE_SAT), cutting their HBM
@@ -266,7 +286,13 @@ class StepInputs(NamedTuple):
     same arrays can drive both the jnp kernel and the Python oracle (tests), and so fault
     schedules are plain data (SURVEY.md section 5, failure injection)."""
 
-    deliver_mask: jax.Array  # [N, N] bool; False = message on edge [dst, src] dropped
+    # Bit-packed delivery mask (ops/bitplane.py), packed over the SOURCE axis:
+    # bit s of deliver_mask[d] clear = the message on physical edge [d, s]
+    # (addressed to d, sent by s) is dropped this tick. sim/faults.py generates
+    # it packed; kernels consume the packed words in the response-side delivery
+    # reduction and unpack once for the transposed request orientation; the
+    # oracle unpacks (tests/oracle.py). W = ceil(N/32).
+    deliver_mask: jax.Array  # [N, W] uint32; bit src of row dst
     skew: jax.Array  # [N] int32 local-clock increment this tick (normally 1)
     timeout_draw: jax.Array  # [N] int32 election timeout to use on any timer reset
     client_cmd: jax.Array  # scalar int32 command value offered this tick; NIL = none
@@ -304,6 +330,14 @@ class StepInfo(NamedTuple):
     # latency l (in ticks, >= 1) has floor(log2(l)) == k, clamped to the last
     # bin. Fixed log-spaced bins make true fleet p50/p95/p99 recoverable in
     # summarize, where the old accumulators only supported a mean of means.
+    # Known undercount (round-5 advisor): the metric attributes entries at a
+    # LIVE LEADER's commit advancement, but lat_frontier advances past
+    # max(commit) even on leaderless ticks (followers advance commit from a
+    # downed leader's final req_commit), so entries whose first commit happens
+    # in a leaderless window are permanently excluded from lat_sum/lat_cnt/
+    # lat_hist. Under crash churn the histogram is therefore a slight
+    # undercount of committed client entries -- biased toward fault-free
+    # windows, never double-counting (docs/PERF.md "latency metric coverage").
     lat_hist: jax.Array  # [LAT_HIST_BINS] int32 (zeros unless client_interval > 0)
     # Election wins that could NOT append their no-op because the ring held no
     # free slot (compaction only). The no-op reserve guarantees room for
@@ -338,6 +372,7 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         req_base_chk=jnp.zeros((n,), jnp.uint32),
         req_off=jnp.zeros((n, n), jnp.int8),
         resp_kind=jnp.zeros((n, n), jnp.int8),
+        pv_grant=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
         v_to=jnp.full((n,), NIL, jnp.int8),
         a_ok_to=jnp.full((n,), NIL, jnp.int8),
         a_match=jnp.zeros((n,), index_dtype(cfg)),
@@ -358,7 +393,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         term=jnp.ones((n,), jnp.int32),
         voted_for=jnp.full((n,), NIL, jnp.int32),
         leader_id=jnp.full((n,), NIL, jnp.int32),
-        votes=jnp.zeros((n, n), bool),
+        votes=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
         next_index=jnp.ones((n, n), idt),
         match_index=jnp.zeros((n, n), idt),
         ack_age=jnp.full((n, n), cfg.ack_age_sat, ack_dtype(cfg)),

@@ -74,13 +74,29 @@ class ApplyLogWriter:
         for i in range(self.cfg.n_nodes):
             commit = int(commits[i])
             base = int(bases[i])
+            # The ring read below assumes every entry in (base, commit] is
+            # still LIVE (the export runs at chunk boundaries, before further
+            # ticks can compact past it). If a layout or call-ordering
+            # regression ever violates that, the reads would silently decode
+            # unrelated ring content as committed values -- fail loudly
+            # instead (round-5 advisor hardening). A real raise, not `assert`:
+            # the guard must survive `python -O`.
+            if commit - base > cap:
+                raise RuntimeError(
+                    f"apply-log export would read compacted slots: node {i} "
+                    f"commit {commit} - base {base} > capacity {cap} "
+                    "(state advanced past a chunk boundary before update()?)"
+                )
             f = self.frontier[i]
             if commit <= f:
                 continue
             with open(self.paths[i], "a") as fh:
                 if f < base:
                     # Entries (f, base] were compacted before this export saw
-                    # them: they exist only as the snapshot triple.
+                    # them: they exist only as the snapshot triple. Gap-marking
+                    # happens at READ time (idx1 <= base never reaches the
+                    # value loop below), so a span lost to compaction can
+                    # never be exported as garbage values.
                     fh.write(f"# snapshot gap {f + 1}..{base}\n")
                     f = base
                 vals = np.asarray(log_vals[i])

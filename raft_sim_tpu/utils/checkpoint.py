@@ -56,7 +56,11 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      contact, driving the thesis-9.6 pre-vote denial rule).
 # v17: int8 ack-age plane (saturation at the narrow ceiling whenever the
 #      responsiveness horizon fits under it).
-_FORMAT_VERSION = 17
+# v18: bit-packed boolean planes (ops/bitplane.py) -- ClusterState.votes became
+#      [N, W = ceil(N/32)] uint32 words; Mailbox gained pv_grant (packed
+#      pre-vote grant bits, formerly bit 2 of resp_kind, which is now a pure
+#      RESP_* 0..3 plane).
+_FORMAT_VERSION = 18
 
 
 def _normalize(path: str) -> str:

@@ -40,6 +40,28 @@ def ack_age_sat(cfg) -> int:
     return ACK_AGE_SAT
 
 
+def unpack_plane(words: np.ndarray, n: int) -> np.ndarray:
+    """Independent numpy restatement of ops/bitplane.py's layout: uint32 words
+    along the LAST axis, bit j of word w = source index 32*w + j. The oracle
+    operates on plain [.., n] bool planes; the packed wire/state forms
+    (ClusterState.votes, Mailbox.pv_grant, StepInputs.deliver_mask) are
+    unpacked at the boundary."""
+    words = np.asarray(words, np.uint32)
+    k = np.arange(n)
+    return ((words[..., k // 32] >> (k % 32)) & 1).astype(bool)
+
+
+def pack_plane(bools: np.ndarray) -> np.ndarray:
+    """Inverse of unpack_plane (last axis -> ceil(n/32) uint32 words)."""
+    b = np.asarray(bools, bool)
+    n = b.shape[-1]
+    w = (n + 31) // 32
+    out = np.zeros(b.shape[:-1] + (w,), np.uint32)
+    for k in range(n):
+        out[..., k // 32] |= b[..., k].astype(np.uint32) << (k % 32)
+    return out
+
+
 def chk_weights(k: int) -> tuple[int, int]:
     """(term weight, value weight) of 0-based log slot k for the committed-prefix
     checksum -- the oracle's statement of log_ops.chk_weights (mod 2^32)."""
@@ -50,7 +72,10 @@ def chk_weights(k: int) -> tuple[int, int]:
 
 
 def state_to_dict(state) -> dict:
-    """Host-side copy of a single-cluster ClusterState (device pytree -> numpy)."""
+    """Host-side copy of a single-cluster ClusterState (device pytree -> numpy).
+    Bit-packed planes (votes, mailbox pv_grant) are unpacked to [N, N] bool:
+    the oracle's view -- and the parity tests' comparison domain -- stays the
+    dense boolean one."""
     d = {
         f: np.asarray(v)
         for f, v in zip(state._fields, state)
@@ -58,6 +83,9 @@ def state_to_dict(state) -> dict:
     }
     mb = state.mailbox
     d["mailbox"] = {f: np.asarray(v) for f, v in zip(mb._fields, mb)}
+    n = d["role"].shape[0]
+    d["votes"] = unpack_plane(d["votes"], n)
+    d["mailbox"]["pv_grant"] = unpack_plane(d["mailbox"]["pv_grant"], n)
     return d
 
 
@@ -130,7 +158,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # (broadcasts; the [sender, receiver] masks read the edge mask transposed),
     # responses are [receiver, responder] packed words (direct).
     # A receiver must be alive now AND at send time (last tick): alive & ~restarted.
-    edge_ok = np.asarray(inp["deliver_mask"], bool).copy()
+    # The delivery mask arrives bit-packed over the source axis; unpack to the
+    # dense [to, from] bool form the handler loops read.
+    edge_ok = unpack_plane(inp["deliver_mask"], n).copy()
     np.fill_diagonal(edge_ok, False)
     recv_up = alive & ~restarted
     req_in = edge_ok.T & alive[:, None] & recv_up[None, :] & (mb["req_type"] != 0)[:, None]
@@ -352,10 +382,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             if role[d] != PRECANDIDATE:
                 continue
             for src in range(n):
+                # The grant bit rides the packed pv_grant plane (unpacked to
+                # [receiver, responder] bool by state_to_dict).
                 if (
                     resp_in[d, src]
-                    and (int(mb["resp_kind"][d, src]) & 3) == RESP_PREVOTE
-                    and int(mb["resp_kind"][d, src]) >= 4
+                    and int(mb["resp_kind"][d, src]) == RESP_PREVOTE
+                    and bool(mb["pv_grant"][d, src])
                 ):
                     votes[d, src] = True
             if int(votes[d].sum()) >= cfg.quorum and alive[d]:
@@ -548,6 +580,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "req_base_chk": np.zeros(n, np.uint32),
         "req_off": z(n, n),
         "resp_kind": z(n, n),
+        "pv_grant": np.zeros((n, n), bool),
         "v_to": v_to,
         "a_ok_to": a_ok_to,
         "a_match": a_match,
@@ -622,7 +655,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             if ar_out[r, q]:
                 rtype += RESP_APPEND
             if pv_out[r, q]:
-                rtype += RESP_PREVOTE + (4 if pv_grant[r, q] else 0)
+                rtype += RESP_PREVOTE
+                # The grant bit rides the (packed) pv_grant plane, not the kind.
+                out["pv_grant"][q, r] = bool(pv_grant[r, q])
             out["resp_kind"][q, r] = rtype
 
     # Monotone commit-latency frontier (types.ClusterState.lat_frontier):

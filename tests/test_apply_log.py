@@ -78,6 +78,26 @@ def test_reset_restarts_the_export_stream(tmp_path):
     assert sess.apply_writer.values(0) == first  # same seed -> same stream again
 
 
+def test_update_rejects_overwide_committed_window(tmp_path):
+    """Round-5 advisor hardening: update() reads the ring assuming every entry
+    in (base, commit] is live (commit - base <= CAP). A state violating that --
+    the signature of ticks advancing past a chunk boundary before export, or a
+    layout regression -- must fail loudly instead of exporting ring garbage."""
+    import jax.numpy as jnp
+    import pytest
+
+    from raft_sim_tpu.utils.apply_log import ApplyLogWriter
+    from raft_sim_tpu import init_batch
+
+    state = init_batch(CFG, jax.random.key(0), 1)
+    bad = state._replace(
+        commit_index=jnp.full_like(state.commit_index, CFG.log_capacity + 1)
+    )
+    w = ApplyLogWriter(str(tmp_path), CFG, cluster=0)
+    with pytest.raises(RuntimeError, match="compacted slots"):
+        w.update(bad)
+
+
 def test_oversized_chunk_reports_snapshot_gap(tmp_path):
     """One giant chunk commits many multiples of the ring: the compacted spans
     are not observable and must surface as explicit gap markers, with the

@@ -1,0 +1,111 @@
+"""Unit tier for ops/bitplane.py: the packed boolean planes under the kernels.
+
+Word-boundary N values matter most -- 31/32 (one word, full and not), 33 (first
+bit of a second word), 51 (config5's wide cluster), 64 (two full words) -- and
+the canonicality invariant (padding bits zero) that makes popcount quorum
+counts exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_sim_tpu.ops import bitplane
+
+NS = [1, 5, 31, 32, 33, 51, 64]
+
+
+@pytest.mark.parametrize("n", NS)
+def test_pack_unpack_roundtrip(n):
+    rng = np.random.default_rng(n)
+    x = jnp.asarray(rng.random((n, n)) < 0.4)
+    p = bitplane.pack(x, axis=1)
+    assert p.shape == (n, bitplane.n_words(n))
+    assert p.dtype == jnp.uint32
+    np.testing.assert_array_equal(np.asarray(bitplane.unpack(p, n, axis=1)), x)
+    # axis 0 too (the alive-mask orientation in the batched kernel).
+    p0 = bitplane.pack(x, axis=0)
+    np.testing.assert_array_equal(np.asarray(bitplane.unpack(p0, n, axis=0)), x)
+
+
+@pytest.mark.parametrize("n", NS)
+def test_popcount_matches_bool_sum(n):
+    rng = np.random.default_rng(100 + n)
+    x = rng.random((n, n)) < 0.5
+    p = bitplane.pack(jnp.asarray(x), axis=1)
+    got = np.asarray(bitplane.count(p, axis=1))
+    np.testing.assert_array_equal(got, x.sum(axis=1))
+    assert got.dtype == np.int32
+
+
+@pytest.mark.parametrize("n", [31, 33, 51])
+def test_pack_is_canonical(n):
+    """Padding bits (positions >= n of the last word) stay zero, and stay zero
+    under the word algebra the kernels use (AND/OR/andnot-with-canonical)."""
+    ones = bitplane.pack(jnp.ones((n, n), bool), axis=1)
+    w = bitplane.n_words(n)
+    valid = (1 << (n - 32 * (w - 1))) - 1  # valid-bit mask of the last word
+    last = np.asarray(ones)[:, -1]
+    assert (last == valid).all()
+    mixed = bitplane.andnot(ones, bitplane.eye(n))
+    assert (np.asarray(mixed)[:, -1] & ~np.uint32(valid) == 0).all()
+    # count() is exact on the all-true plane (no phantom padding bits).
+    assert (np.asarray(bitplane.count(ones, axis=1)) == n).all()
+
+
+def test_eye_and_rows():
+    n = 51
+    np.testing.assert_array_equal(
+        np.asarray(bitplane.unpack(bitplane.eye(n), n, axis=1)), np.eye(n, dtype=bool)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bitplane.unpack(bitplane.full_row(n), n)), np.ones(n, bool)
+    )
+    br = np.asarray(bitplane.unpack(bitplane.bit_row(40, n), n))
+    assert br[40] and br.sum() == 1
+
+
+def test_set_and_get_bit():
+    n = 33
+    plane = jnp.zeros((n, bitplane.n_words(n)), jnp.uint32)
+    plane = bitplane.set_bit(plane, 2, 32)  # first bit of the second word
+    assert bool(bitplane.get_bit(plane, 2, 32))
+    assert not bool(bitplane.get_bit(plane, 2, 31))
+    assert int(bitplane.count(plane, axis=1).sum()) == 1
+    cleared = bitplane.set_bit(plane, 2, 32, value=False)
+    assert int(bitplane.count(cleared, axis=1).sum()) == 0
+
+
+def test_batch_minor_and_vmap_forms_agree():
+    """The same functions serve [N, N] (vmap-lifted) and [N, N, B] (batch-minor)
+    planes; both must produce identical words."""
+    n, b = 51, 7
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((b, n, n)) < 0.5)  # [B, N, N] batch-leading
+    per_cluster = jax.vmap(lambda p: bitplane.pack(p, axis=1))(x)  # [B, N, W]
+    minor = bitplane.pack(jnp.moveaxis(x, 0, -1), axis=1)  # [N, W, B]
+    np.testing.assert_array_equal(
+        np.asarray(per_cluster), np.asarray(jnp.moveaxis(minor, -1, 0))
+    )
+    back = bitplane.unpack(minor, n, axis=1)  # [N, N, B]
+    np.testing.assert_array_equal(
+        np.asarray(jnp.moveaxis(back, -1, 0)), np.asarray(x)
+    )
+
+
+def test_matches_oracle_numpy_forms():
+    """tests/oracle.py restates pack/unpack independently (it may not import
+    the package); pin the two layouts against each other so they cannot
+    drift."""
+    from tests import oracle
+
+    n = 51
+    rng = np.random.default_rng(3)
+    x = rng.random((n, n)) < 0.5
+    np.testing.assert_array_equal(
+        np.asarray(bitplane.pack(jnp.asarray(x), axis=1)), oracle.pack_plane(x)
+    )
+    np.testing.assert_array_equal(
+        oracle.unpack_plane(np.asarray(bitplane.pack(jnp.asarray(x), axis=1)), n), x
+    )

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_sim_tpu import FOLLOWER, LEADER, NIL, RaftConfig, StepInputs, init_state
+from raft_sim_tpu.ops import bitplane
 from raft_sim_tpu.sim import scan
 from raft_sim_tpu.types import REQ_APPEND
 from tests import oracle as orc
@@ -308,7 +309,7 @@ def test_election_win_appends_noop_entry():
         role=s.role.at[0].set(1),  # CANDIDATE
         term=s.term.at[0].set(5),
         voted_for=s.voted_for.at[0].set(0),
-        votes=s.votes.at[0, 0].set(True),
+        votes=bitplane.set_bit(s.votes, 0, 0),
     )
     s = resp_wire(s, 0, 1, RESP_VOTE, term=5, ok=True)
     s = resp_wire(s, 0, 2, RESP_VOTE, term=5, ok=True)

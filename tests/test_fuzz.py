@@ -40,6 +40,7 @@ def test_config3_randomized_timeouts():
     assert float(np.median(m.first_leader_tick)) < 30
 
 
+@pytest.mark.slow
 def test_config4_drop_and_skew():
     """Bernoulli drop p in [0, 0.3] + clock skew (config 4 shrunk): safety never
     violated; the vast majority of clusters still stabilize."""
@@ -69,6 +70,7 @@ def test_config5_wide_cluster_partitions():
     assert int(m.max_commit.max()) > 0  # commits happen even while partitioned halves churn
 
 
+@pytest.mark.slow
 def test_partition_heals_and_reconverges():
     """A permanently partitioned cluster cannot elect with quorum on the minority
     side; after the partition window passes, commits resume monotonically. Verified
@@ -101,6 +103,7 @@ def test_skew_only_still_safe():
     assert (m.first_leader_tick < NEVER).all()
 
 
+@pytest.mark.slow
 def test_crash_restart_fuzz():
     """Node crash/restart fuzzing (VERDICT round-1 item 3): with leaders regularly
     crashing, safety invariants hold everywhere and clusters re-elect and keep
@@ -195,6 +198,7 @@ def test_kitchen_sink_all_faults_at_once():
     assert int(m.max_commit.max()) > 0
 
 
+@pytest.mark.slow
 def test_kitchen_sink_with_compaction_and_redirect():
     """The round-4 surface under the same everything-at-once fault mix: a small
     compaction ring (absolute indices, snapshots, election no-ops) fed through

@@ -23,16 +23,21 @@ from raft_sim_tpu import (
 )
 from raft_sim_tpu.models import raft
 from raft_sim_tpu import types as raft_types
+from raft_sim_tpu.ops import bitplane
 from raft_sim_tpu.types import REQ_APPEND, REQ_VOTE, RESP_APPEND, RESP_VOTE
 
 CFG = RaftConfig(n_nodes=5, log_capacity=8, max_entries_per_rpc=4)
 
 
-def quiet_inputs(cfg, far=1000):
-    """No faults, no client traffic, clocks advancing but timers far away."""
+def quiet_inputs(cfg, far=1000, deliver=None):
+    """No faults, no client traffic, clocks advancing but timers far away.
+    `deliver` overrides the (full) [N, N] bool delivery mask; StepInputs carries
+    it bit-packed (ops/bitplane.py)."""
     n = cfg.n_nodes
+    if deliver is None:
+        deliver = jnp.ones((n, n), bool)
     return StepInputs(
-        deliver_mask=jnp.ones((n, n), bool),
+        deliver_mask=bitplane.pack(deliver, axis=1),
         skew=jnp.ones((n,), jnp.int32),
         timeout_draw=jnp.full((n,), far, jnp.int32),
         client_cmd=jnp.int32(NIL),
@@ -309,7 +314,7 @@ def test_candidate_wins_with_quorum():
         role=s.role.at[0].set(CANDIDATE),
         term=s.term.at[0].set(2),
         voted_for=s.voted_for.at[0].set(0),
-        votes=s.votes.at[0, 0].set(True),
+        votes=bitplane.set_bit(s.votes, 0, 0),  # self-vote
     )
     s = resp_wire(s, 0, 1, RESP_VOTE, term=2, ok=True)
     s = resp_wire(s, 0, 2, RESP_VOTE, term=2, ok=True)
@@ -334,7 +339,7 @@ def test_candidate_needs_quorum():
     s = s._replace(
         role=s.role.at[0].set(CANDIDATE),
         term=s.term.at[0].set(2),
-        votes=s.votes.at[0, 0].set(True),
+        votes=bitplane.set_bit(s.votes, 0, 0),
     )
     s = resp_wire(s, 0, 1, RESP_VOTE, term=2, ok=True)
     s2, _ = step(CFG, s)
@@ -347,7 +352,7 @@ def test_stale_vote_response_ignored():
     s = s._replace(
         role=s.role.at[0].set(CANDIDATE),
         term=s.term.at[0].set(5),
-        votes=s.votes.at[0, 0].set(True),
+        votes=bitplane.set_bit(s.votes, 0, 0),
     )
     s = resp_wire(s, 0, 1, RESP_VOTE, term=4, ok=True)
     s = resp_wire(s, 0, 2, RESP_VOTE, term=4, ok=True)
@@ -426,7 +431,7 @@ def test_timeout_starts_election():
     assert int(s2.role[2]) == CANDIDATE
     assert int(s2.term[2]) == 2
     assert int(s2.voted_for[2]) == 2
-    assert bool(s2.votes[2, 2])
+    assert bool(bitplane.get_bit(s2.votes, 2, 2))
     assert int(s2.mailbox.req_type[2]) == REQ_VOTE  # broadcast to all peers
     assert int(s2.mailbox.req_term[2]) == 2
 
@@ -452,8 +457,7 @@ def test_dropped_messages_are_dropped():
     """deliver_mask=False edges deliver nothing (the reference's swallowed HTTP
     exception, client.clj:38-40)."""
     s = rv_wire(base_state(), 0, term=5)
-    inp = quiet_inputs(CFG)
-    inp = inp._replace(deliver_mask=inp.deliver_mask.at[1, 0].set(False))
+    inp = quiet_inputs(CFG, deliver=jnp.ones((5, 5), bool).at[1, 0].set(False))
     s2, _ = step(CFG, s, inp)
     assert int(s2.term[1]) == 1  # nothing adopted
     assert resp_type_of(s2.mailbox, 0, 1) == 0  # no response
@@ -479,7 +483,7 @@ def test_restart_wipes_volatile_keeps_persistent():
     s = make_leader(s, 0, 2)
     s = s._replace(
         voted_for=s.voted_for.at[0].set(0),
-        votes=s.votes.at[0].set(jnp.ones((5,), bool)),
+        votes=s.votes.at[0].set(bitplane.full_row(5)),
         match_index=s.match_index.at[0].set(jnp.full((5,), 3, s.match_index.dtype)),
         commit_index=s.commit_index.at[0].set(3),
     )
@@ -517,11 +521,11 @@ def test_down_leader_is_silent():
 def test_down_node_receives_nothing():
     """Messages to a down node die in flight: no response, no vote, no term adoption."""
     s = rv_wire(base_state(), 0, term=5)
-    inp = quiet_inputs(CFG)._replace(
-        alive=jnp.ones((5,), bool).at[1].set(False),
+    inp = quiet_inputs(
+        CFG,
         # Scope delivery to the down node so live receivers don't react instead.
-        deliver_mask=jnp.eye(5, dtype=bool) | jnp.zeros((5, 5), bool).at[1, 0].set(True),
-    )
+        deliver=jnp.eye(5, dtype=bool) | jnp.zeros((5, 5), bool).at[1, 0].set(True),
+    )._replace(alive=jnp.ones((5,), bool).at[1].set(False))
     s2, _ = step(CFG, s, inp)
     assert int(s2.term[1]) == 1
     assert int(s2.voted_for[1]) == NIL
@@ -534,7 +538,7 @@ def test_down_candidate_cannot_win_on_banked_votes():
         role=s.role.at[0].set(CANDIDATE),
         term=s.term.at[0].set(2),
         voted_for=s.voted_for.at[0].set(0),
-        votes=s.votes.at[0].set(jnp.ones((5,), bool)),
+        votes=s.votes.at[0].set(bitplane.full_row(5)),
     )
     inp = quiet_inputs(CFG)._replace(alive=jnp.ones((5,), bool).at[0].set(False))
     s2, _ = step(CFG, s, inp)

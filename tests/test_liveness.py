@@ -17,6 +17,7 @@ import pytest
 
 from raft_sim_tpu import NIL, RaftConfig, StepInputs, init_state
 from raft_sim_tpu.models import raft
+from raft_sim_tpu.ops import bitplane
 
 CFG = RaftConfig(n_nodes=5, log_capacity=64, max_entries_per_rpc=4, client_interval=1)
 
@@ -28,7 +29,7 @@ def run_ticks(cfg, s, n_ticks, alive, cmd_base=100):
     step = jax.jit(raft.step, static_argnums=0)
     for t in range(n_ticks):
         inp = StepInputs(
-            deliver_mask=jnp.ones((n, n), bool),
+            deliver_mask=bitplane.pack(jnp.ones((n, n), bool), axis=1),
             skew=jnp.ones((n,), jnp.int32),
             timeout_draw=jnp.full((n,), 8 + (t % 5), jnp.int32),
             client_cmd=jnp.int32(cmd_base + t),
@@ -73,7 +74,7 @@ def test_healed_laggard_catches_up():
     assert gap > 2 * CFG.max_entries_per_rpc  # the laggard is far behind on return
     # Node 4 restarts (volatile wipe; its empty log is its durable state).
     restart = StepInputs(
-        deliver_mask=jnp.ones((n, n), bool),
+        deliver_mask=bitplane.pack(jnp.ones((n, n), bool), axis=1),
         skew=jnp.ones((n,), jnp.int32),
         timeout_draw=jnp.full((n,), 9, jnp.int32),
         client_cmd=jnp.int32(NIL),

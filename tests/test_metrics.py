@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_sim_tpu import CANDIDATE, LEADER, RaftConfig, types
+from raft_sim_tpu.ops import bitplane
 from raft_sim_tpu.parallel import summarize
 from raft_sim_tpu.parallel.mesh import _hist_percentile
 from raft_sim_tpu.sim import scan
@@ -99,6 +100,26 @@ def test_hist_percentile_interpolation():
     assert _hist_percentile(h2, 0.25) < 2.0
 
 
+def test_hist_percentile_first_bin_clamps_to_lower_edge():
+    """Round-5 advisor finding: interpolating inside the FIRST nonempty bin
+    invents mass below the distribution's minimum -- a run whose every latency
+    is exactly 1 tick (all counts in bin 0 = [1, 2)) must report every
+    percentile as 1.0, not 1.5."""
+    h = np.zeros(16, np.int64)
+    h[0] = 1000
+    assert _hist_percentile(h, 0.50) == 1.0
+    assert _hist_percentile(h, 0.95) == 1.0
+    assert _hist_percentile(h, 0.99) == 1.0
+    # Same rule at a higher first bin: all mass in [4, 8) clamps to 4.0 ...
+    h2 = np.zeros(16, np.int64)
+    h2[2] = 10
+    assert _hist_percentile(h2, 0.5) == 4.0
+    # ... while bins ABOVE the first nonempty one still interpolate.
+    h3 = np.zeros(16, np.int64)
+    h3[0], h3[2] = 10, 10
+    assert 4.0 < _hist_percentile(h3, 0.99) < 8.0
+
+
 def test_latency_histogram_matches_counts():
     """Fleet histogram mass equals the latency count, and the recovered
     percentiles bracket the known direct-mode latency (~3 ticks on a reliable
@@ -142,7 +163,7 @@ def test_noop_blocked_counted_when_ring_full():
         role=s.role.at[0].set(CANDIDATE),
         term=s.term.at[0].set(2),
         voted_for=s.voted_for.at[0].set(0),
-        votes=s.votes.at[0].set(jnp.ones((5,), bool)),
+        votes=s.votes.at[0].set(bitplane.full_row(5)),
     )
     s2, info = step(RING_CFG, s)
     assert int(s2.role[0]) == LEADER  # the win itself goes through
@@ -157,7 +178,7 @@ def test_noop_blocked_zero_with_room():
         role=s.role.at[0].set(CANDIDATE),
         term=s.term.at[0].set(2),
         voted_for=s.voted_for.at[0].set(0),
-        votes=s.votes.at[0].set(jnp.ones((5,), bool)),
+        votes=s.votes.at[0].set(bitplane.full_row(5)),
     )
     s2, info = step(RING_CFG, s)
     assert int(s2.role[0]) == LEADER

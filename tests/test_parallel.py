@@ -3,6 +3,7 @@ xla_force_host_platform_device_count=8; SURVEY.md section 4, distributed tests).
 
 import jax
 import numpy as np
+import pytest
 
 from raft_sim_tpu import RaftConfig
 from raft_sim_tpu.parallel import make_mesh, simulate_sharded, summarize
@@ -29,6 +30,7 @@ def test_sharded_matches_single_device():
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_sharded_matches_single_device_compaction_redirect():
     """Device-count invariance holds for the full round-4 feature surface: ring
     compaction (wide index planes, snapshot wire header) + redirect routing."""
@@ -73,6 +75,7 @@ def test_summarize_under_faults():
     assert s.n_stable > 32
 
 
+@pytest.mark.slow
 def test_session_sharded_matches_unsharded():
     """Session(devices=8) must equal Session(devices=None) bit-for-bit: the driver's
     sharded chunked path (jit propagating the input sharding) preserves trajectories

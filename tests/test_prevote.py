@@ -14,6 +14,7 @@ import numpy as np
 
 from raft_sim_tpu import RaftConfig, StepInputs, init_state
 from raft_sim_tpu.models import raft
+from raft_sim_tpu.ops import bitplane
 from raft_sim_tpu.sim import scan
 from raft_sim_tpu.types import (
     CANDIDATE,
@@ -33,7 +34,7 @@ def isolate(cfg, node, far=1000):
     """Inputs with `node` partitioned away from everyone (both directions)."""
     n = cfg.n_nodes
     mask = jnp.ones((n, n), bool).at[node, :].set(False).at[:, node].set(False)
-    return quiet_inputs(cfg, far=far)._replace(deliver_mask=mask)
+    return quiet_inputs(cfg, far=far, deliver=mask)
 
 
 # -------------------------------------------------------------- grant/deny rules
@@ -51,9 +52,10 @@ def pv_wire(s, src, term_prospective, last_idx=0, last_term=0):
 
 
 def pv_resp_of(mb, q, r):
-    """(responded, granted) for the pre-vote response edge [q, r]."""
+    """(responded, granted) for the pre-vote response edge [q, r]: the type
+    rides resp_kind, the grant bit the packed pv_grant plane."""
     kind = int(mb.resp_kind[q, r])
-    return (kind & 3) == RESP_PREVOTE, kind >= 4
+    return kind == RESP_PREVOTE, bool(bitplane.get_bit(mb.pv_grant, q, r))
 
 
 def test_quiet_voter_grants_probe_without_adopting_term():
@@ -100,7 +102,9 @@ def test_pre_quorum_promotes_to_real_candidate():
     s = s._replace(
         role=s.role.at[0].set(PRECANDIDATE),
         votes=s.votes.at[0].set(
-            jnp.zeros((5,), bool).at[0].set(True).at[1].set(True).at[2].set(True)
+            bitplane.pack(
+                jnp.zeros((5,), bool).at[0].set(True).at[1].set(True).at[2].set(True)
+            )
         ),
     )
     s2, _ = step(CFG, s)

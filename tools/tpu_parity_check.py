@@ -72,7 +72,7 @@ CONFIGS = {
         500,
     ),
     # PreVote probe rounds under churn (round 5): prospective-term wire fields,
-    # per-edge grant bits in resp_kind, heard_clock arithmetic.
+    # packed per-edge grant bits (Mailbox.pv_grant), heard_clock arithmetic.
     "prevote-churn": (
         dict(
             n_nodes=5,

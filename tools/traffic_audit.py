@@ -1,0 +1,279 @@
+"""Static bytes-moved-per-tick audit: the quantitative side of the bit-packing
+work (and, where packing cannot win, the roofline argument).
+
+Every `lax.scan` tick reads the whole carry (ClusterState + Mailbox +
+RunMetrics) from HBM and writes it back, and materializes the per-tick
+StepInputs; at large N those planes ARE the tick's HBM traffic (docs/PERF.md
+"what the profile says"). This tool enumerates the carry exactly as the
+kernels declare it -- `jax.eval_shape` over `init_state`/`make_inputs`, so the
+accounting can never drift from the real structures -- and prices each leaf
+two ways:
+
+  - logical bytes (shape x itemsize), and
+  - TPU-padded bytes in the batch-minor layout ([..., B]: the minor dim rides
+    the 128-wide lane tile, the second-minor dim pads to the dtype's sublane
+    multiple -- 8 for 4-byte, 16 for 2-byte, 32 for 1-byte elements), the
+    physical footprint models/raft_batched.py exists to control.
+
+It then rebuilds the same table for the DENSE pre-packing layout (votes and
+deliver_mask as [N, N] bool, pre-vote grants riding resp_kind, no pv_grant
+plane) and reports the per-config delta plus a roofline projection: given the
+recorded round-5 throughput of each config (docs/PERF.md history table,
+measured on the real chip), the implied HBM rate is ticks/s x bytes/tick; a
+layout change can speed up an HBM-bound config by at most the traffic ratio.
+That makes the config5 verdict honest either way -- either the packed layout's
+reduction projects past the 3M ticks/s bar, or this audit documents that the
+bool planes were never a large enough fraction of the tick for packing to get
+there (docs/PERF.md "bit-packing audit" section holds the conclusions).
+
+Runs on CPU (nothing is executed on device -- eval_shape only):
+
+    python tools/traffic_audit.py                     # configs 3/4/5 table
+    python tools/traffic_audit.py --configs config5 --top 12
+    python tools/traffic_audit.py --json              # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu.ops import bitplane
+from raft_sim_tpu.sim import faults, scan
+from raft_sim_tpu.types import init_state
+from raft_sim_tpu.utils.config import PRESETS, RaftConfig
+
+# Recorded round-5 throughput per preset (docs/PERF.md history table, real
+# chip, best-of-2): the anchor for the implied-HBM-rate roofline. A config
+# absent here gets bytes accounting but no projection.
+RECORDED_TICKS_PER_S = {
+    "config3": 38.1e6,
+    "config4": 22.7e6,
+    "config5": 2.14e6,
+}
+
+# TPU minor-tile sublane multiple by element width (lane dim is always 128).
+_SUBLANE = {4: 8, 2: 16, 1: 32}
+
+
+def _invariant_leaves(cfg: RaftConfig) -> set[str]:
+    """Carry leaves the tick passes through UNTOUCHED for this config: XLA
+    elides loop-invariant scan-carry components from the per-tick HBM round
+    trip (the round-4 lesson recorded in docs/PERF.md -- re-writing them as
+    fresh zeros each tick measurably regressed config3), so they are excluded
+    from the traffic totals."""
+    inv = set()
+    if not cfg.pre_vote:
+        inv |= {"mb.pv_grant", "heard_clock"}
+    if not cfg.compaction:
+        inv |= {
+            "mb.req_base", "mb.req_base_term", "mb.req_base_chk",
+            "log_base", "base_term", "base_chk",
+        }
+    if not cfg.client_redirect:
+        inv |= {"client_pend", "client_dst"}
+    if cfg.client_interval == 0:
+        inv |= {"lat_frontier"}
+    return inv
+
+
+def _leaf_rows(cfg: RaftConfig):
+    """(group, name, shape, dtype) for every scan-carry leaf + per-tick input,
+    taken from the real structures via eval_shape (shapes are per cluster);
+    loop-invariant carry legs (see _invariant_leaves) are dropped."""
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    state = jax.eval_shape(lambda k: init_state(cfg, k), key)
+    inputs = jax.eval_shape(
+        lambda k: faults.make_inputs(cfg, k, jnp.int32(0)), key
+    )
+    metrics = jax.eval_shape(scan.init_metrics)
+    rows = []
+    for f, v in zip(state._fields, state):
+        if f == "mailbox":
+            continue
+        rows.append(("state", f, tuple(v.shape), v.dtype.itemsize))
+    for f, v in zip(state.mailbox._fields, state.mailbox):
+        rows.append(("mailbox", f"mb.{f}", tuple(v.shape), v.dtype.itemsize))
+    for f, v in zip(inputs._fields, inputs):
+        rows.append(("inputs", f"in.{f}", tuple(v.shape), v.dtype.itemsize))
+    for f, v in zip(metrics._fields, metrics):
+        rows.append(("metrics", f"metric.{f}", tuple(v.shape), v.dtype.itemsize))
+    skip = _invariant_leaves(cfg)
+    return [r for r in rows if r[1] not in skip]
+
+
+def _densify(rows, cfg: RaftConfig):
+    """The pre-packing layout of the same carry: [N, N] bool votes and
+    delivery mask, pre-vote grants riding resp_kind (no pv_grant plane)."""
+    n = cfg.n_nodes
+    out = []
+    for g, name, shape, isize in rows:
+        if name == "votes" or name == "in.deliver_mask":
+            out.append((g, name + " (dense)", (n, n), 1))
+        elif name == "mb.pv_grant":
+            continue  # its bit rode the resp_kind byte plane
+        else:
+            out.append((g, name, shape, isize))
+    return out
+
+
+def _logical(shape, isize):
+    return math.prod(shape) * isize if shape else isize
+
+
+def _padded(shape, isize, batch):
+    """Physical bytes per cluster in the batch-minor layout: shape + (B,) with
+    the trailing two dims tiled (sublane x 128 lanes). Divided back by B, so
+    lane padding amortizes across the batch and the reported overhead is the
+    sublane padding the layout actually pays per cluster."""
+    bm = tuple(shape) + (batch,)
+    dims = list(bm)
+    dims[-1] = -(-dims[-1] // 128) * 128
+    if len(dims) >= 2:
+        sub = _SUBLANE[isize]
+        dims[-2] = -(-dims[-2] // sub) * sub
+    return math.prod(dims) * isize / batch
+
+
+def audit(cfg: RaftConfig, batch: int):
+    """Both layouts' per-cluster-tick byte totals. Carry leaves move twice per
+    tick (read + write); inputs once (materialized from the key stream)."""
+
+    def total(rows):
+        log = pad = 0.0
+        for g, _, shape, isize in rows:
+            mult = 1 if g == "inputs" else 2
+            log += mult * _logical(shape, isize)
+            pad += mult * _padded(shape, isize, batch)
+        return log, pad
+
+    packed_rows = _leaf_rows(cfg)
+    dense_rows = _densify(packed_rows, cfg)
+    packed_log, packed_pad = total(packed_rows)
+    dense_log, dense_pad = total(dense_rows)
+    # The limiting case of ANY bool-plane compression: the boolean planes cost
+    # zero bytes. If even this cannot reach a throughput bar, no packing can.
+    boolfree = [
+        r
+        for r in packed_rows
+        if r[1] not in ("votes", "in.deliver_mask", "mb.pv_grant")
+    ]
+    boolfree_log, boolfree_pad = total(boolfree)
+    return {
+        "packed_rows": packed_rows,
+        "dense_rows": dense_rows,
+        "packed_logical": packed_log,
+        "packed_padded": packed_pad,
+        "dense_logical": dense_log,
+        "dense_padded": dense_pad,
+        "boolfree_logical": boolfree_log,
+        "boolfree_padded": boolfree_pad,
+    }
+
+
+def _fmt_bytes(b):
+    return f"{b / 1024:.2f} KiB" if b >= 1024 else f"{b:.0f} B"
+
+
+def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout):
+    a = audit(cfg, batch)
+    w = bitplane.n_words(cfg.n_nodes)
+    print(f"\n== {name}: N={cfg.n_nodes} (W={w}), CAP={cfg.log_capacity}, "
+          f"E={cfg.max_entries_per_rpc}, batch={batch} ==", file=out)
+    print(f"{'plane':28} {'shape':>14} {'logical':>10} {'padded':>10}", file=out)
+    biggest = sorted(
+        a["packed_rows"],
+        key=lambda r: -_padded(r[2], r[3], batch),
+    )[:top]
+    for g, nm, shape, isize in biggest:
+        print(
+            f"{nm:28} {str(shape):>14} {_logical(shape, isize):>10,} "
+            f"{_padded(shape, isize, batch):>10,.0f}",
+            file=out,
+        )
+    dl, dp = a["dense_logical"], a["dense_padded"]
+    pl, pp = a["packed_logical"], a["packed_padded"]
+    print(f"{'per-cluster-tick DENSE':28} {'':>14} {dl:>10,.0f} {dp:>10,.0f}", file=out)
+    print(f"{'per-cluster-tick PACKED':28} {'':>14} {pl:>10,.0f} {pp:>10,.0f}", file=out)
+    print(
+        f"reduction: logical {100 * (1 - pl / dl):.1f}%  "
+        f"padded {100 * (1 - pp / dp):.1f}%",
+        file=out,
+    )
+    rec = RECORDED_TICKS_PER_S.get(name)
+    res = {
+        "config": name,
+        "n": cfg.n_nodes,
+        "dense_logical": dl,
+        "dense_padded": dp,
+        "packed_logical": pl,
+        "packed_padded": pp,
+        "boolfree_padded": a["boolfree_padded"],
+    }
+    if rec:
+        bw = rec * dp
+        ceiling = bw / pp
+        bound = bw / a["boolfree_padded"]
+        res |= {
+            "recorded_ticks_per_s": rec,
+            "implied_hbm_bytes_per_s": bw,
+            "packed_roofline_ticks_per_s": ceiling,
+            "boolfree_roofline_ticks_per_s": bound,
+        }
+        print(
+            f"recorded (r05, chip): {rec / 1e6:.2f}M ticks/s -> implied HBM rate "
+            f"{bw / 1e9:.1f} GB/s on the dense carry",
+            file=out,
+        )
+        print(
+            f"packed roofline at that rate: {ceiling / 1e6:.2f}M ticks/s "
+            f"({ceiling / rec:.3f}x)",
+            file=out,
+        )
+        print(
+            f"bool-free bound (boolean planes at ZERO bytes): "
+            f"{bound / 1e6:.2f}M ticks/s ({bound / rec:.3f}x) -- no bool-plane "
+            "compression can beat this",
+            file=out,
+        )
+    return res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--configs",
+        default="config3,config4,config5",
+        help="comma-separated preset names (see raft_sim_tpu.utils.config.PRESETS)",
+    )
+    ap.add_argument("--top", type=int, default=8, help="largest planes listed")
+    ap.add_argument("--json", action="store_true", help="emit one JSON line")
+    args = ap.parse_args(argv)
+
+    # With --json the human tables go to stderr so stdout is exactly one
+    # parseable JSON line (the bench-artifact lesson: machine output must not
+    # interleave with narration).
+    table_out = sys.stderr if args.json else sys.stdout
+    results = []
+    for name in args.configs.split(","):
+        name = name.strip()
+        if name not in PRESETS:
+            print(f"unknown preset {name!r}", file=sys.stderr)
+            return 2
+        cfg, batch = PRESETS[name]
+        results.append(report(name, cfg, batch, args.top, out=table_out))
+    if args.json:
+        print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
