@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -74,16 +75,46 @@ SMOKE_BATCH = {
 SMOKE_TICKS = {"config1": 1_000, "config6": 1_000, "config6r": 1_000}
 
 
+def _telemetry_window(ticks: int) -> int:
+    """A window size that divides the run (the windowed scan requires it):
+    the finest of a few round divisors, falling back to one whole-run window."""
+    for d in (16, 10, 8, 5, 4, 2):
+        if ticks % d == 0:
+            return ticks // d
+    return ticks
+
+
 def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
-          quality_seeds: int = 3) -> dict:
+          quality_seeds: int = 3, telemetry_dir: str | None = None,
+          config_name: str = "custom") -> dict:
     # Quality runs use FIXED seeds 0..quality_seeds-1 (reproducible across
     # invocations, comparable across commits) and their per-cluster metrics are
     # pooled, so the reported p50s sample quality_seeds x batch clusters instead
     # of one seed's worth. The first doubles as the compile warmup. Timed repeats
     # then use time-salted seeds (capped so seed_base + r stays int32).
+    #
+    # With telemetry_dir set, the seed-0 quality run goes through the windowed
+    # telemetry scan instead and its window records land in
+    # telemetry_dir/<config_name>/ under the SAME schema driver.py writes
+    # (utils/telemetry_sink.py) -- bit-exact, so the pooled quality metrics are
+    # unchanged (tests/test_telemetry.py pins windowed == monolithic).
     pooled = []
     for qs in range(quality_seeds):
-        final, m = scan.simulate(cfg, qs, batch, ticks)
+        if qs == 0 and telemetry_dir is not None:
+            from raft_sim_tpu.sim import telemetry
+            from raft_sim_tpu.utils.telemetry_sink import TelemetrySink
+
+            window = _telemetry_window(ticks)
+            sink = TelemetrySink(
+                os.path.join(telemetry_dir, config_name), cfg, seed=qs,
+                batch=batch, window=window, ring=0, source="bench",
+            )
+            final, m, records, _ = telemetry.simulate_windowed(
+                cfg, qs, batch, ticks, window
+            )
+            sink.append_windows(jax.device_get(records))
+        else:
+            final, m = scan.simulate(cfg, qs, batch, ticks)
         pooled.append(jax.device_get(m))
     q_metrics = type(pooled[0])(
         *(np.concatenate([np.asarray(getattr(m, f)) for m in pooled])
@@ -100,6 +131,11 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
         best = min(best, time.perf_counter() - t0)
 
     s = summarize(q_metrics)  # pooled fixed-seed quality metrics
+    if telemetry_dir is not None:
+        # summary.json must describe the SAME run the manifest/windows do
+        # (seed 0 alone) -- the pooled 3-seed rollup `s` stays in the bench
+        # row, not in the telemetry directory.
+        sink.write_summary(summarize(pooled[0])._asdict())
     value = batch * ticks / best
     return {
         "cluster_ticks_per_s": round(value, 1),
@@ -114,6 +150,7 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
         "lat_p50": s.lat_p50,
         "lat_p95": s.lat_p95,
         "lat_p99": s.lat_p99,
+        "lat_excluded": s.lat_excluded,
         "total_cmds": s.total_cmds,
         "violations": s.total_violations,
         "noop_blocked": s.noop_blocked,
@@ -131,6 +168,10 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument("--smoke", action="store_true",
                     help="CPU-sized shrink (small batches) of the same matrix")
+    ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                    help="also write each config's seed-0 quality run as a "
+                         "telemetry directory (DIR/<config>/, the same schema "
+                         "driver.py --telemetry-dir emits)")
     args = ap.parse_args()
 
     names = (
@@ -158,7 +199,8 @@ def main() -> None:
             else MATRIX_TICKS.get(name, 300)
         )
         print(f"bench {name}: batch={batch} ticks={ticks}...", file=sys.stderr)
-        matrix[name] = bench(cfg, batch, ticks, args.repeats)
+        matrix[name] = bench(cfg, batch, ticks, args.repeats,
+                             telemetry_dir=args.telemetry_dir, config_name=name)
 
     # The headline is the north-star workload (config3) whenever it ran; benching a
     # different single preset labels itself via "workload" so vs_baseline is never

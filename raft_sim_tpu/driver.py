@@ -80,6 +80,8 @@ class Session:
         self.seed = seed
         self.devices = devices
         self.apply_writer = None
+        self.telemetry = None  # TelemetrySink (attach_telemetry)
+        self._tel_rec = None  # flight-recorder carry (batch-minor)
         self.reset()
 
     def reset(self) -> None:
@@ -96,6 +98,12 @@ class Session:
         # silently drop the new run's early commits).
         if self.apply_writer is not None:
             self.attach_apply_log(self.apply_writer.directory, self.apply_writer.cluster)
+        if self.telemetry is not None:
+            self.attach_telemetry(
+                self.telemetry.directory,
+                window=self.telemetry.window,
+                ring=self.telemetry.ring,
+            )
 
     def _apply_sharding(self) -> None:
         if self.devices is None:
@@ -132,19 +140,89 @@ class Session:
         self.apply_writer = ApplyLogWriter(directory, self.cfg, cluster)
         self.apply_writer.update(self.state)  # anything already committed
 
+    def attach_telemetry(self, directory: str, window: int = 64, ring: int = 32) -> None:
+        """Stream windowed fleet telemetry to `directory` (manifest +
+        windows.jsonl, utils/telemetry_sink.py) and arm a `ring`-deep flight
+        recorder that freezes each cluster's last ticks at its first safety
+        violation (ring=0 disables it). run() then scans through the telemetry
+        path (sim/telemetry.py) -- trajectories stay bit-identical to the
+        plain path; the only cost is the extra telemetry carry traffic
+        (docs/OBSERVABILITY.md). Call finalize_telemetry() at the end of the
+        experiment to export violating clusters' flight recordings."""
+        from raft_sim_tpu.sim import telemetry
+        from raft_sim_tpu.utils.telemetry_sink import TelemetrySink
+
+        if window < 1:
+            raise ValueError(f"telemetry window must be >= 1, got {window}")
+        if ring < 0:
+            raise ValueError(f"telemetry ring must be >= 0, got {ring}")
+        self.telemetry = TelemetrySink(
+            directory, self.cfg, seed=self.seed, batch=self.batch,
+            window=window, ring=ring,
+        )
+        self._tel_rec = (
+            telemetry.init_recorder(self.cfg, ring, self.batch) if ring else None
+        )
+
     def run(self, n_ticks: int, chunk: int = 4096, progress: bool = False) -> None:
-        def cb(done, state, metrics):
-            if self.apply_writer is not None:
-                self.apply_writer.update(state)
+        def progress_line(done, metrics):
             if progress:
                 v = int(np.sum(np.asarray(metrics.violations)))
                 print(f"  {done}/{n_ticks} ticks, violations={v}", file=sys.stderr)
+
+        if self.telemetry is not None:
+            from raft_sim_tpu.sim import telemetry
+
+            def cb_t(done, state, metrics, records):
+                self.telemetry.append_windows(records)
+                if self.apply_writer is not None:
+                    self.apply_writer.update(state)
+                progress_line(done, metrics)
+                return False
+
+            self.state, m, self._tel_rec = telemetry.run_chunked_telemetry(
+                self.cfg, self.state, self.keys, n_ticks,
+                window=self.telemetry.window, recorder=self._tel_rec,
+                chunk=chunk, callback=cb_t,
+            )
+            self.metrics = chunked.merge_metrics(self.metrics, m)
+            return
+
+        def cb(done, state, metrics):
+            if self.apply_writer is not None:
+                self.apply_writer.update(state)
+            progress_line(done, metrics)
             return False
 
         self.state, m = chunked.run_chunked(
             self.cfg, self.state, self.keys, n_ticks, chunk=chunk, callback=cb
         )
         self.metrics = chunked.merge_metrics(self.metrics, m)
+
+    def finalize_telemetry(self, max_flights: int = 8) -> dict:
+        """End-of-experiment telemetry export: write summary.json and, for up
+        to `max_flights` clusters whose flight recorder froze on a violation,
+        the recorder's final ticks as flight_<cluster>.jsonl. Returns
+        {"flights": [cluster ids exported], "summary": path}."""
+        if self.telemetry is None:
+            raise RuntimeError("no telemetry attached (attach_telemetry)")
+        from raft_sim_tpu.sim import telemetry
+
+        flights = []
+        if self._tel_rec is not None:
+            frozen = np.flatnonzero(np.asarray(self._tel_rec.frozen))
+            for cluster in frozen[:max_flights]:
+                ticks, infos = telemetry.export_cluster(self._tel_rec, int(cluster))
+                self.telemetry.write_flight(int(cluster), ticks, infos)
+                flights.append(int(cluster))
+            if frozen.size > max_flights:
+                print(
+                    f"telemetry: {frozen.size} violating clusters, exported "
+                    f"first {max_flights} flight recordings",
+                    file=sys.stderr,
+                )
+        path = self.telemetry.write_summary(self.summary())
+        return {"flights": flights, "summary": path}
 
     def offer(self, value: int, wait: int = 0) -> dict:
         """Offer one client command and advance one tick -- the reference's ad-hoc
@@ -237,6 +315,8 @@ class Session:
         cfg, state, keys, metrics, seed = checkpoint.load(path)
         self = cls.__new__(cls)
         self.apply_writer = None
+        self.telemetry = None
+        self._tel_rec = None
         self.cfg = cfg
         self.batch = state.role.shape[0]
         self.seed = seed
@@ -263,7 +343,7 @@ def _offer_tick(cfg: RaftConfig, state, keys, metrics, value):
     s_t = raft_batched.to_batch_minor(state)
     m_t = raft_batched.to_batch_minor(metrics)  # histogram leaf is [BINS, B] inside
     before = metrics.total_cmds
-    s2, m2 = scan.tick_batch_minor(cfg, s_t, keys, m_t, client_cmd=value)
+    s2, m2, _ = scan.tick_batch_minor(cfg, s_t, keys, m_t, client_cmd=value)
     metrics = raft_batched.from_batch_minor(m2)
     return raft_batched.from_batch_minor(s2), metrics, metrics.total_cmds - before
 
@@ -335,6 +415,17 @@ def main(argv=None) -> int:
                             "file, log.clj:74-75)")
     run_p.add_argument("--apply-cluster", type=int, default=0,
                        help="cluster index --apply-log exports (default 0)")
+    run_p.add_argument("--telemetry-dir", metavar="DIR", default=None,
+                       help="write windowed fleet telemetry (manifest + "
+                            "windows.jsonl, utils/telemetry_sink.py) and "
+                            "flight recordings of violating clusters to DIR")
+    run_p.add_argument("--telemetry-window", type=int, default=64, metavar="W",
+                       help="ticks aggregated per telemetry window record "
+                            "(default 64)")
+    run_p.add_argument("--telemetry-ring", type=int, default=32, metavar="K",
+                       help="flight-recorder depth: last K ticks of StepInfo "
+                            "per cluster, frozen at the first violation "
+                            "(0 disables; default 32)")
     _add_config_flags(run_p)
 
     sub.add_parser("presets", help="list the BASELINE config presets")
@@ -384,10 +475,10 @@ def main(argv=None) -> int:
             ap.error(str(ex))
 
     if args.trace_ticks or args.trace_events:
-        if args.save or args.profile or args.apply_log:
-            ap.error("--save/--profile/--apply-log have no effect with "
-                     "--trace-ticks/--trace-events (tracing does not advance "
-                     "the session)")
+        if args.save or args.profile or args.apply_log or args.telemetry_dir:
+            ap.error("--save/--profile/--apply-log/--telemetry-dir have no "
+                     "effect with --trace-ticks/--trace-events (tracing does "
+                     "not advance the session)")
         n = args.trace_ticks or args.ticks
         infos, states = sess.trace(n, cluster=args.trace_cluster)
         if args.trace_events:
@@ -402,6 +493,16 @@ def main(argv=None) -> int:
         try:
             sess.attach_apply_log(args.apply_log, cluster=args.apply_cluster)
         except IndexError as ex:
+            ap.error(str(ex))
+
+    if args.telemetry_dir:
+        try:
+            sess.attach_telemetry(
+                args.telemetry_dir,
+                window=args.telemetry_window,
+                ring=args.telemetry_ring,
+            )
+        except ValueError as ex:
             ap.error(str(ex))
 
     import contextlib
@@ -422,6 +523,15 @@ def main(argv=None) -> int:
     out["wall_s"] = round(dt, 3)
     out["cluster_ticks_per_s"] = round(sess.batch * args.ticks / dt, 1)
     print(json.dumps(out))
+
+    if args.telemetry_dir:
+        fin = sess.finalize_telemetry()
+        if fin["flights"]:
+            print(
+                f"telemetry: flight recordings exported for clusters "
+                f"{fin['flights']} under {args.telemetry_dir}",
+                file=sys.stderr,
+            )
 
     if args.save:
         sess.save(args.save)

@@ -495,15 +495,25 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         # Session.offer payloads outside that range are excluded instead of
         # decoding as garbage latencies.
         newly = (abs1 > s.lat_frontier) & (abs1 <= commit[:, None])
-        lm = (
-            (is_leader & inp.alive)[:, None]
-            & newly
-            & (log_val_arr >= 1)
-            & (log_val_arr <= s.now)
-        )
+        cli = (log_val_arr >= 1) & (log_val_arr <= s.now)  # tick-plausible values
+        lm = (is_leader & inp.alive)[:, None] & newly & cli
         lats = jnp.where(lm, s.now - log_val_arr + 1, 0)  # [N, CAP]
         lat_sum = jnp.sum(lats).astype(jnp.int32)
         lat_cnt = jnp.sum(lm).astype(jnp.int32)
+        # Coverage gap counter (StepInfo.lat_excluded): client entries the
+        # frontier advance crosses without attribution. The frontier advances
+        # to max(commit) regardless of leadership; count the crossed client
+        # entries on the (lowest-id) node HOLDING that max -- its log carries
+        # everything in (frontier, max commit] by log matching -- and subtract
+        # what lat_cnt attributed. Clamped at zero: under compaction the
+        # max-commit node may have compacted a crossed slot the leader still
+        # counted, and split-brain double-counts inflate lat_cnt.
+        is_maxc = commit == jnp.max(commit)
+        hnode = jnp.min(jnp.where(is_maxc, ids, n))
+        crossed = (ids == hnode)[:, None] & newly & cli
+        lat_excluded = jnp.maximum(
+            jnp.sum(crossed).astype(jnp.int32) - lat_cnt, 0
+        )
         # Histogram bin = floor(log2(l)), clamped to the last bin: bit length
         # via an unrolled binary reduction (no float log in the hot loop).
         bl = jnp.zeros_like(lats)
@@ -520,6 +530,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         lat_sum = jnp.int32(0)
         lat_cnt = jnp.int32(0)
         lat_hist = jnp.zeros((LAT_HIST_BINS,), jnp.int32)
+        lat_excluded = jnp.int32(0)
         lat_frontier = s.lat_frontier
 
     # ---- phase 5.5: log compaction -------------------------------------------------
@@ -835,7 +846,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
 
     info = _step_info(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
-        lat_sum, lat_cnt, lat_hist, noop_blocked,
+        lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
     )
     return new_state, info
 
@@ -852,6 +863,7 @@ def _step_info(
     lat_sum: jax.Array,
     lat_cnt: jax.Array,
     lat_hist: jax.Array,
+    lat_excluded: jax.Array,
     noop_blocked: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
@@ -976,6 +988,7 @@ def _step_info(
         lat_sum=lat_sum,
         lat_cnt=lat_cnt,
         lat_hist=lat_hist,
+        lat_excluded=lat_excluded,
         noop_blocked=noop_blocked,
         lm_skipped_pairs=lm_skipped,
     )

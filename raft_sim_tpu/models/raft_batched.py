@@ -429,15 +429,19 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             abs1 = sl + 1
         # Carried-frontier dedup + tick-encoded value gate (raft.py).
         newly = (abs1 > s.lat_frontier[None, None, :]) & (abs1 <= commit[:, None, :])
-        lm = (
-            (is_leader & inp.alive)[:, None, :]
-            & newly
-            & (log_val_arr >= 1)
-            & (log_val_arr <= s.now[None, None, :])
-        )
+        cli = (log_val_arr >= 1) & (log_val_arr <= s.now[None, None, :])
+        lm = (is_leader & inp.alive)[:, None, :] & newly & cli
         lats = jnp.where(lm, s.now[None, None, :] - log_val_arr + 1, 0)  # [N, CAP, B]
         lat_sum = jnp.sum(lats, axis=(0, 1)).astype(jnp.int32)
         lat_cnt = jnp.sum(lm, axis=(0, 1)).astype(jnp.int32)
+        # Coverage gap counter: crossed-but-unattributed client entries, read
+        # on the lowest-id max-commit node (raft.py for the full rationale).
+        is_maxc = commit == jnp.max(commit, axis=0)[None, :]
+        hnode = jnp.min(jnp.where(is_maxc, ids2, n), axis=0)  # [B]
+        crossed = (ids2 == hnode[None, :])[:, None, :] & newly & cli
+        lat_excluded = jnp.maximum(
+            jnp.sum(crossed, axis=(0, 1)).astype(jnp.int32) - lat_cnt, 0
+        )
         # Histogram bin = floor(log2(l)) via unrolled bit-length (raft.py).
         bl = jnp.zeros_like(lats)
         v = lats
@@ -453,6 +457,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         lat_sum = jnp.zeros_like(s.now)
         lat_cnt = jnp.zeros_like(s.now)
         lat_hist = jnp.zeros((LAT_HIST_BINS, b), jnp.int32)
+        lat_excluded = jnp.zeros_like(s.now)
         lat_frontier = s.lat_frontier
 
     # ---- phase 5.5: log compaction (raft.py) -------------------------------------
@@ -723,7 +728,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
 
     info = _step_info_b(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
-        lat_sum, lat_cnt, lat_hist, noop_blocked,
+        lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
     )
     return new_state, info
 
@@ -740,6 +745,7 @@ def _step_info_b(
     lat_sum: jax.Array,
     lat_cnt: jax.Array,
     lat_hist: jax.Array,
+    lat_excluded: jax.Array,
     noop_blocked: jax.Array,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
@@ -857,6 +863,7 @@ def _step_info_b(
         lat_sum=lat_sum,
         lat_cnt=lat_cnt,
         lat_hist=lat_hist,
+        lat_excluded=lat_excluded,
         noop_blocked=noop_blocked,
         lm_skipped_pairs=lm_skipped,
     )

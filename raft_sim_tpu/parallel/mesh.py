@@ -154,9 +154,12 @@ class FleetSummary(NamedTuple):
     max_term: int
     total_msgs: int
     total_cmds: int  # client commands accepted fleet-wide (offered vs committed audit)
-    # Fleet p50 of per-cluster MEAN offer->commit latency (ticks), measured at
-    # each live leader's commit advancement; None when no cluster committed any
-    # client entry (e.g. client_interval == 0).
+    # LEGACY: fleet p50 of per-cluster MEAN offer->commit latency (ticks) -- a
+    # mean-of-means, superseded by the true per-entry percentiles below
+    # (lat_p50/p95/p99 from the on-device histogram). Kept for continuity with
+    # the BENCH_* history; both are derived in ONE pass (_latency_rollup) from
+    # the same gathered metrics, so the two readouts cannot drift apart. None
+    # when no cluster committed any client entry (e.g. client_interval == 0).
     p50_commit_latency: float | None
     # TRUE per-entry latency percentiles, recovered from the fleet-summed
     # log2-bin histogram (RunMetrics.lat_hist) with linear interpolation inside
@@ -165,6 +168,12 @@ class FleetSummary(NamedTuple):
     lat_p50: float | None
     lat_p95: float | None
     lat_p99: float | None
+    # Latency coverage gap (RunMetrics.lat_excluded): client entries whose
+    # first commit fell in a leaderless window -- crossed by the dedup frontier
+    # but never attributed into the histogram. The percentiles above cover
+    # lat_cnt / (lat_cnt + lat_excluded) of committed client entries
+    # (docs/PERF.md "latency metric coverage").
+    lat_excluded: int
     # Liveness/coverage counters (RunMetrics): election wins that found no
     # no-op slot (compaction livelock early-warning), and node pairs the ring
     # log-matching check could not compare.
@@ -219,6 +228,30 @@ def _hist_percentile(hist, q: float) -> float | None:
     return float(1 << len(hist))
 
 
+def _latency_rollup(m) -> dict:
+    """All four latency readouts (legacy mean-of-means p50 AND the true
+    histogram percentiles) plus the coverage-gap counter, from ONE host-side
+    pass over the same gathered metrics -- the single code path that keeps the
+    legacy and histogram numbers from drifting (they answer the same question
+    at different fidelities, so they must always be computed together)."""
+    import numpy as np
+
+    committed = m.lat_cnt > 0
+    p50_lat = (
+        float(np.median(m.lat_sum[committed] / m.lat_cnt[committed]))
+        if np.any(committed)
+        else None
+    )
+    hist = np.sum(np.asarray(m.lat_hist, dtype=np.int64), axis=0)  # [BINS]
+    return {
+        "p50_commit_latency": p50_lat,  # legacy (see FleetSummary docstring)
+        "lat_p50": _hist_percentile(hist, 0.50),
+        "lat_p95": _hist_percentile(hist, 0.95),
+        "lat_p99": _hist_percentile(hist, 0.99),
+        "lat_excluded": int(np.sum(m.lat_excluded, dtype=np.int64)),
+    }
+
+
 def summarize(metrics) -> FleetSummary:
     """Fleet-level rollup of a batched RunMetrics. The p50 quantile is computed
     host-side from the (small, [batch]-shaped) stable-tick vector. Handles
@@ -231,13 +264,6 @@ def summarize(metrics) -> FleetSummary:
     # None (JSON null) rather than inf: json.dumps(inf) emits non-standard `Infinity`.
     p50 = float(np.median(reached)) if reached.size else None
     m = jax.device_get(metrics)
-    committed = m.lat_cnt > 0
-    p50_lat = (
-        float(np.median(m.lat_sum[committed] / m.lat_cnt[committed]))
-        if np.any(committed)
-        else None
-    )
-    hist = np.sum(np.asarray(m.lat_hist, dtype=np.int64), axis=0)  # [BINS]
     return FleetSummary(
         n_clusters=int(m.ticks.shape[0]),
         total_violations=int(np.sum(m.violations)),
@@ -246,10 +272,7 @@ def summarize(metrics) -> FleetSummary:
         max_term=int(np.max(m.max_term)),
         total_msgs=int(np.sum(m.total_msgs, dtype=np.int64)),
         total_cmds=int(np.sum(m.total_cmds, dtype=np.int64)),
-        p50_commit_latency=p50_lat,
-        lat_p50=_hist_percentile(hist, 0.50),
-        lat_p95=_hist_percentile(hist, 0.95),
-        lat_p99=_hist_percentile(hist, 0.99),
         noop_blocked=int(np.sum(m.noop_blocked, dtype=np.int64)),
         lm_skipped_pairs=int(np.sum(m.lm_skipped_pairs, dtype=np.int64)),
+        **_latency_rollup(m),
     )

@@ -38,6 +38,7 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
         lat_sum=a.lat_sum + b.lat_sum,
         lat_cnt=a.lat_cnt + b.lat_cnt,
         lat_hist=a.lat_hist + b.lat_hist,
+        lat_excluded=a.lat_excluded + b.lat_excluded,
         noop_blocked=a.noop_blocked + b.noop_blocked,
         lm_skipped_pairs=a.lm_skipped_pairs + b.lm_skipped_pairs,
         ticks=a.ticks + b.ticks,

@@ -60,6 +60,10 @@ class RunMetrics(NamedTuple):
     # one non-scalar metric leaf: [LAT_HIST_BINS] per cluster (public [B, BINS]
     # layout; the batch-minor scan carries it [BINS, B] internally).
     lat_hist: jax.Array  # [LAT_HIST_BINS] int32
+    # Latency coverage gap (StepInfo.lat_excluded): client entries the frontier
+    # crossed in leaderless windows, permanently dropped from lat_sum/lat_cnt/
+    # lat_hist -- the undercount docs/PERF.md documents, now measured.
+    lat_excluded: jax.Array  # int32
     # Liveness/coverage counters (StepInfo.noop_blocked / lm_skipped_pairs).
     noop_blocked: jax.Array  # int32: election wins denied their no-op slot
     lm_skipped_pairs: jax.Array  # int32: pair-checks skipped by ring log matching
@@ -87,6 +91,7 @@ def init_metrics() -> RunMetrics:
         lat_sum=z,
         lat_cnt=z,
         lat_hist=jnp.zeros((LAT_HIST_BINS,), jnp.int32),
+        lat_excluded=z,
         noop_blocked=z,
         lm_skipped_pairs=z,
         ticks=z,
@@ -112,6 +117,7 @@ def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
         lat_sum=m.lat_sum + info.lat_sum,
         lat_cnt=m.lat_cnt + info.lat_cnt,
         lat_hist=m.lat_hist + info.lat_hist,
+        lat_excluded=m.lat_excluded + info.lat_excluded,
         noop_blocked=m.noop_blocked + info.noop_blocked,
         lm_skipped_pairs=m.lm_skipped_pairs + info.lm_skipped_pairs,
         ticks=m.ticks + 1,
@@ -182,7 +188,8 @@ def run_batch_minor(
 
     def body(carry, _):
         s, m = carry
-        return tick_batch_minor(cfg, s, keys, m, step_fn=step_fn), None
+        s2, m2, _ = tick_batch_minor(cfg, s, keys, m, step_fn=step_fn)
+        return (s2, m2), None
 
     # Metrics ride the scan batch-minor too (the histogram leaf is [BINS, B]
     # there; scalars-per-cluster are [B] in either layout).
@@ -204,7 +211,9 @@ def tick_batch_minor(cfg, s, keys, metrics, step_fn=None, client_cmd=None):
     draws are vmapped batch-leading, then transposed). The single shared tick body
     for the scan loop above AND interactive single-tick drivers (Session.offer),
     so the two can never drift. `client_cmd` overrides the scheduled client input
-    for this tick."""
+    for this tick. Returns (state, metrics, StepInfo) -- the per-tick info rides
+    batch-minor ([B] scalars, [BINS, B] histogram); callers that only need the
+    carry drop it (XLA dead-code-eliminates the unused output)."""
     from raft_sim_tpu.models import raft_batched
 
     if step_fn is None:
@@ -215,7 +224,7 @@ def tick_batch_minor(cfg, s, keys, metrics, step_fn=None, client_cmd=None):
     inp_t = raft_batched.to_batch_minor(inp)
     s2, info = step_fn(cfg, s, inp_t)
     m2 = _accumulate(metrics, info, s.now)  # all fields [B]: elementwise
-    return (s2, m2)
+    return (s2, m2, info)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))

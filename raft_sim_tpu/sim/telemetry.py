@@ -1,0 +1,297 @@
+"""Fleet telemetry: on-device windowed aggregation + a violation flight recorder.
+
+The reference's entire observability story is an unconditional per-iteration
+println of node + message (core.clj:182-186); the rebuild's full-fidelity
+equivalent -- `scan.run(trace=True)` stacking per-tick StepInfo, or whole
+ClusterStates -- is exactly the memory pattern a 100k-cluster soak cannot
+survive ([T] rows of everything). This module is the production-scale middle
+ground, two device-side mechanisms over the SAME tick body as the hot path
+(`scan.tick_batch_minor`, so telemetry can never observe a different
+trajectory than it perturbs):
+
+1. **Windowed aggregation** (`run_batch_minor_telemetry`): a nested scan folds
+   each tick's StepInfo into a window-local RunMetrics inside the inner carry
+   and emits ONE `WindowRecord` per `window` ticks -- [T/W] records instead of
+   [T] rows. Reduction is exact, not lossy: every RunMetrics fold is
+   associative over window boundaries (sums, min/max, later-wins for
+   min_commit), so merging the window records with `chunked.merge_metrics`
+   reproduces the monolithic run's RunMetrics BIT-FOR-BIT
+   (tests/test_telemetry.py pins this against the full per-tick stack).
+   `first_viol_tick` adds the one thing the run-level metrics cannot recover:
+   WHEN inside the window the first invariant trip happened.
+
+2. **Violation flight recorder** (`FlightRecorder`): a K-deep device-side ring
+   of the last K ticks' StepInfo per cluster that FREEZES on the first tick
+   any `viol_*` flag fires -- when 1 cluster in 100k misbehaves, its final K
+   ticks come home for `sim/trace.py` rendering without ever storing full
+   trajectories. The freeze includes the violating tick itself (write first,
+   then latch).
+
+Both mechanisms live in EXTRA scan-carry legs beside (state, metrics); the
+ClusterState carry and the checkpoint format are untouched, and with telemetry
+disabled the plain `scan.run_batch_minor` path compiles exactly as before.
+The extra HBM traffic telemetry does cost is accounted statically by
+`tools/traffic_audit.py --telemetry-ring` (docs/OBSERVABILITY.md has the
+window/ring sizing tradeoffs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_sim_tpu.models import raft_batched
+from raft_sim_tpu.sim import scan
+from raft_sim_tpu.sim.chunked import merge_metrics
+from raft_sim_tpu.types import StepInfo
+from raft_sim_tpu.utils.config import RaftConfig
+
+NEVER = scan.NEVER
+
+
+class WindowRecord(NamedTuple):
+    """One W-tick window's telemetry for every cluster (public layout: every
+    leaf leads with [batch, n_windows, ...] after a run; batch-minor inside)."""
+
+    start: jax.Array  # int32: absolute tick of the window's first tick
+    # Absolute tick of the first invariant violation inside this window
+    # (NEVER if the window is clean) -- the intra-window locator the
+    # window-level RunMetrics cannot provide.
+    first_viol_tick: jax.Array  # int32
+    # RunMetrics accumulated over THIS window only. Folding these with
+    # chunked.merge_metrics across windows reproduces the monolithic run's
+    # metrics bit-for-bit (every fold op is associative across the cut).
+    metrics: scan.RunMetrics
+
+
+class FlightRecorder(NamedTuple):
+    """Device-side ring of the last K ticks' StepInfo per cluster, frozen at
+    the first violation. Internal layout is batch-minor (`ring` leaves
+    [K, ..., B]); `from_batch_minor` restores the public [B, K, ...] form."""
+
+    ring: StepInfo  # each StepInfo leaf stacked K deep along axis 0
+    tick: jax.Array  # [K] int32: absolute tick held in each slot (-1 = empty)
+    pos: jax.Array  # int32: ticks recorded so far (next slot = pos % K)
+    frozen: jax.Array  # bool: latched by the first viol_* tick (inclusive)
+
+
+def init_recorder(cfg: RaftConfig, k: int, batch: int) -> FlightRecorder:
+    """Zeroed K-deep recorder, batch-minor ([..., B] trailing on every leaf)."""
+    from raft_sim_tpu.types import LAT_HIST_BINS
+
+    def leaf(dtype, *mid):
+        return jnp.zeros((k, *mid, batch), dtype)
+
+    ring = StepInfo(
+        viol_election_safety=leaf(bool),
+        viol_commit=leaf(bool),
+        viol_log_matching=leaf(bool),
+        leader=leaf(jnp.int32),
+        n_leaders=leaf(jnp.int32),
+        max_term=leaf(jnp.int32),
+        max_commit=leaf(jnp.int32),
+        min_commit=leaf(jnp.int32),
+        msgs_delivered=leaf(jnp.int32),
+        cmds_injected=leaf(jnp.int32),
+        lat_sum=leaf(jnp.int32),
+        lat_cnt=leaf(jnp.int32),
+        lat_hist=leaf(jnp.int32, LAT_HIST_BINS),
+        lat_excluded=leaf(jnp.int32),
+        noop_blocked=leaf(jnp.int32),
+        lm_skipped_pairs=leaf(jnp.int32),
+    )
+    return FlightRecorder(
+        ring=ring,
+        tick=jnp.full((k, batch), -1, jnp.int32),
+        pos=jnp.zeros((batch,), jnp.int32),
+        frozen=jnp.zeros((batch,), bool),
+    )
+
+
+def _record(rec: FlightRecorder, info: StepInfo, now: jax.Array, k: int) -> FlightRecorder:
+    """Write one tick's StepInfo into the ring (per-cluster slot pos % K),
+    gated on ~frozen; latch frozen AFTER the write so the violating tick is
+    the ring's newest entry."""
+    slot = rec.pos % k  # [B]
+    write = ~rec.frozen  # [B]
+    oh1 = (jnp.arange(k, dtype=jnp.int32)[:, None] == slot[None, :]) & write[None, :]
+
+    def upd(leaf, val):
+        # leaf [K, ..., B]; val [..., B]: broadcast the slot one-hot over the
+        # middle dims (only lat_hist has one).
+        oh = oh1.reshape((k,) + (1,) * (leaf.ndim - 2) + oh1.shape[-1:])
+        return jnp.where(oh, val[None], leaf)
+
+    ring = StepInfo(*(upd(l, v) for l, v in zip(rec.ring, info)))
+    bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
+    return FlightRecorder(
+        ring=ring,
+        tick=upd(rec.tick, now),
+        pos=rec.pos + write,
+        frozen=rec.frozen | (write & bad),
+    )
+
+
+def run_batch_minor_telemetry(
+    cfg: RaftConfig,
+    state,
+    keys: jax.Array,
+    n_ticks: int,
+    window: int,
+    recorder: FlightRecorder | None = None,
+    step_fn=None,
+):
+    """`scan.run_batch_minor` with telemetry carry legs: same trajectories
+    (bit-for-bit -- the tick body is shared), plus [n_ticks/window]
+    WindowRecords and an optional flight recorder threaded through.
+
+    `n_ticks` must divide by `window` (the chunked driver handles remainders
+    by a final shorter call). `recorder` enters and leaves BATCH-MINOR (the
+    chunked path threads it across calls without relayouting); pass
+    `init_recorder(...)` to start one, None to disable. State/keys/metrics/
+    records use the public [B, ...]-leading convention at entry/exit.
+
+    Returns (final_state, metrics, records, recorder).
+    """
+    if n_ticks % window:
+        raise ValueError(f"n_ticks {n_ticks} must divide by window {window}")
+    if step_fn is None:
+        step_fn = raft_batched.step_b
+    batch = state.role.shape[0]
+    ring_k = 0 if recorder is None else recorder.tick.shape[0]
+    s_t = raft_batched.to_batch_minor(state)
+    m0 = raft_batched.to_batch_minor(scan.init_metrics_batch(batch))
+
+    def inner(carry, _):
+        s, wm, fv, rec = carry
+        now = s.now  # [B] absolute tick BEFORE the step (lockstep across B)
+        s2, wm2, info = scan.tick_batch_minor(cfg, s, keys, wm, step_fn=step_fn)
+        bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
+        fv2 = jnp.minimum(fv, jnp.where(bad, now, NEVER))
+        rec2 = _record(rec, info, now, ring_k) if ring_k else rec
+        return (s2, wm2, fv2, rec2), None
+
+    def outer(carry, _):
+        s, m, rec = carry
+        start = s.now
+        fv0 = jnp.full((batch,), NEVER, jnp.int32)
+        (s2, wm, fv, rec2), _ = lax.scan(
+            inner, (s, m0, fv0, rec), None, length=window
+        )
+        out = WindowRecord(start=start, first_viol_tick=fv, metrics=wm)
+        return (s2, merge_metrics(m, wm), rec2), out
+
+    (final_t, metrics, rec_t), recs = lax.scan(
+        outer, (s_t, m0, recorder), None, length=n_ticks // window
+    )
+    # Records stack [n_windows, ..., B]: one batch-axis move yields the public
+    # [B, n_windows, ...] layout (per-cluster leading, like everything else).
+    return (
+        raft_batched.from_batch_minor(final_t),
+        raft_batched.from_batch_minor(metrics),
+        raft_batched.from_batch_minor(recs),
+        rec_t,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 5))
+def simulate_windowed(
+    cfg: RaftConfig, seed, batch: int, n_ticks: int, window: int, ring: int = 0
+):
+    """`scan.simulate` with telemetry: one-call batched init + windowed scan.
+    Returns (final_state, metrics, records, recorder) -- metrics/trajectories
+    bit-identical to `scan.simulate` for the same (cfg, seed, batch, n_ticks).
+    `ring` > 0 enables the flight recorder at that depth."""
+    root = jax.random.key(seed)
+    k_init, k_run = jax.random.split(root)
+    from raft_sim_tpu.types import init_batch
+
+    state = init_batch(cfg, k_init, batch)
+    keys = jax.random.split(k_run, batch)
+    rec = init_recorder(cfg, ring, batch) if ring else None
+    return run_batch_minor_telemetry(cfg, state, keys, n_ticks, window, rec)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6))
+def _chunk_t(cfg, state, keys, rec, n, window, ring_k):
+    recorder = rec if ring_k else None
+    return run_batch_minor_telemetry(cfg, state, keys, n, window, recorder)
+
+
+def run_chunked_telemetry(
+    cfg: RaftConfig,
+    state,
+    keys: jax.Array,
+    n_ticks: int,
+    window: int,
+    recorder: FlightRecorder | None = None,
+    chunk: int = 4096,
+    callback=None,
+):
+    """Long-horizon telemetry runs: the `chunked.run_chunked` analogue with
+    window records offloaded to the host between chunks (so a 10M-tick soak
+    holds at most chunk/window records on device at once).
+
+    Chunks are rounded to whole windows; a final REMAINDER window shorter than
+    `window` is emitted if n_ticks does not divide (records are
+    self-describing: `metrics.ticks` carries each window's true width).
+    `callback(ticks_done, state, merged_metrics, records)` receives each
+    chunk's records in the public [B, n_windows, ...] layout; returning True
+    stops early. Returns (final_state, merged_metrics, recorder).
+    """
+    batch = state.role.shape[0]
+    ring_k = 0 if recorder is None else recorder.tick.shape[0]
+    win_per_chunk = max(1, chunk // window)
+    metrics = scan.init_metrics_batch(batch)
+    done = 0
+    while done < n_ticks:
+        left = n_ticks - done
+        if left >= window:
+            n = min(win_per_chunk, left // window) * window
+            w = window
+        else:
+            n = w = left  # remainder: one final short window
+        state, m, recs, recorder = _chunk_t(
+            cfg, state, keys, recorder, n, w, ring_k
+        )
+        metrics = merge_metrics(metrics, m)
+        done += n
+        if callback is not None and callback(done, state, metrics, recs):
+            break
+    return state, metrics, recorder
+
+
+def reduce_records(records: WindowRecord) -> scan.RunMetrics:
+    """Fold a stacked WindowRecord (leaves [B, n_windows, ...]) back into the
+    run-level RunMetrics ([B, ...]) -- the host-side half of the bit-exactness
+    contract: this equals the monolithic scan's metrics exactly."""
+    n_windows = records.start.shape[1]
+    take = lambda w: jax.tree.map(lambda x: x[:, w], records.metrics)
+    m = take(0)
+    for w in range(1, n_windows):
+        m = merge_metrics(m, take(w))
+    return m
+
+
+def export_cluster(recorder: FlightRecorder, cluster: int):
+    """Decode one cluster's ring into chronological (ticks, stacked StepInfo)
+    ready for `trace.info_lines` -- the flight-recorder readout. Takes the
+    recorder in its carried batch-minor layout (what every run/chunk call
+    returns); empty slots (tick < 0) are dropped.
+
+    Returns (ticks [k_valid] np.ndarray, StepInfo with leading [k_valid] axis),
+    oldest tick first -- for a frozen cluster the last row IS the violation."""
+
+    def leaf(x):  # [K, ..., B] -> this cluster's [K, ...]
+        return np.moveaxis(np.asarray(x), -1, 0)[cluster]
+
+    ticks = leaf(recorder.tick)  # [K]
+    order = np.argsort(ticks, kind="stable")
+    order = order[ticks[order] >= 0]
+    infos = StepInfo(*(leaf(l)[order] for l in recorder.ring))
+    return ticks[order], infos

@@ -335,10 +335,20 @@ class StepInfo(NamedTuple):
     # max(commit) even on leaderless ticks (followers advance commit from a
     # downed leader's final req_commit), so entries whose first commit happens
     # in a leaderless window are permanently excluded from lat_sum/lat_cnt/
-    # lat_hist. Under crash churn the histogram is therefore a slight
-    # undercount of committed client entries -- biased toward fault-free
-    # windows, never double-counting (docs/PERF.md "latency metric coverage").
+    # lat_hist. Under crash churn the histogram is therefore an undercount of
+    # committed client entries -- biased toward fault-free windows, never
+    # double-counting -- and `lat_excluded` below COUNTS the dropped entries so
+    # the coverage gap is measured, not guessed (docs/PERF.md "latency metric
+    # coverage" carries the quantified numbers).
     lat_hist: jax.Array  # [LAT_HIST_BINS] int32 (zeros unless client_interval > 0)
+    # Client entries the latency frontier crossed this tick WITHOUT being
+    # counted into lat_sum/lat_cnt/lat_hist: the frontier advances to
+    # max(commit) every tick, but attribution needs a live leader, so entries
+    # first committed in a leaderless window fall through. Counted on the
+    # (lowest-id) max-commit node whose commit defines the frontier advance;
+    # exact without compaction, conservative (clamped >= 0) with it, where the
+    # max-commit node may already have compacted a crossed slot away.
+    lat_excluded: jax.Array  # int32 (zero unless client_interval > 0)
     # Election wins that could NOT append their no-op because the ring held no
     # free slot (compaction only). The no-op reserve guarantees room for
     # max(1, compact_margin // 2) consecutive commit-free elections; a deeper

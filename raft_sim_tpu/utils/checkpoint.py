@@ -60,7 +60,10 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      [N, W = ceil(N/32)] uint32 words; Mailbox gained pv_grant (packed
 #      pre-vote grant bits, formerly bit 2 of resp_kind, which is now a pure
 #      RESP_* 0..3 plane).
-_FORMAT_VERSION = 18
+# v19: metrics v3 -- RunMetrics gained lat_excluded (the latency coverage-gap
+#      counter: client entries first committed in leaderless windows, measured
+#      instead of documented-away). ClusterState is unchanged.
+_FORMAT_VERSION = 19
 
 
 def _normalize(path: str) -> str:

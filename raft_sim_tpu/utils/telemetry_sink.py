@@ -1,0 +1,315 @@
+"""Host-side telemetry sink: a schema'd on-disk record of a run.
+
+The reference prints everything to stdout and keeps nothing (core.clj:182-186);
+`bench.py`, `driver.py`, and `summarize` each used to print ad-hoc JSON with no
+shared shape. This module is the one schema all of them write now:
+
+    <dir>/manifest.json        run identity: schema version, full config + its
+                               hash, seed, batch, window/ring sizes, jax +
+                               backend versions -- enough to reproduce the run
+                               or to refuse to diff incomparable ones.
+    <dir>/windows.jsonl        one line per telemetry window (fleet-aggregated
+                               WindowRecord; sim/telemetry.py) -- the always-on
+                               cheap observability stream.
+    <dir>/flight_<c>.jsonl     the flight recorder's final K ticks for cluster
+                               c (written only for violating clusters): full
+                               per-tick StepInfo, renderable via
+                               tools/metrics_report.py or sim/trace.info_lines.
+    <dir>/summary.json         the end-of-run FleetSummary rollup (plus caller
+                               extras like wall time).
+
+Everything is line-delimited JSON with integer-exact values (no floats in the
+window stream), so two runs diff textually and `validate()` can check the
+whole directory without a schema library. `tools/metrics_report.py` renders
+and diffs these directories; the tier-1 CI workflow validates one as a smoke
+test and uploads it as an artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+
+import numpy as np
+
+from raft_sim_tpu.types import LAT_HIST_BINS, StepInfo
+from raft_sim_tpu.utils.config import RaftConfig
+
+# Bump on any incompatible change to the manifest or line formats; validate()
+# refuses mismatched directories and metrics_report refuses to diff them.
+TELEMETRY_SCHEMA_VERSION = 1
+
+# A "never happened" tick sentinel (scan.NEVER) becomes JSON null.
+_NEVER = 2**31 - 1
+
+# Per-line required integer fields of windows.jsonl (lat_hist is checked
+# separately: a list of LAT_HIST_BINS non-negative ints).
+WINDOW_FIELDS = (
+    "window",
+    "start",
+    "ticks",
+    "violations",
+    "violating_clusters",
+    "msgs",
+    "cmds",
+    "max_term",
+    "max_commit",
+    "lat_sum",
+    "lat_cnt",
+    "lat_excluded",
+    "noop_blocked",
+    "lm_skipped_pairs",
+)
+
+MANIFEST_FIELDS = (
+    "schema_version",
+    "source",
+    "created_unix",
+    "config",
+    "config_hash",
+    "seed",
+    "batch",
+    "window",
+    "ring",
+    "jax_version",
+    "backend",
+)
+
+
+def config_hash(cfg: RaftConfig) -> str:
+    """Stable short hash of the full config (key-sorted JSON), the manifest's
+    comparability key: two runs diff cleanly iff their hashes match."""
+    blob = json.dumps(dataclasses.asdict(cfg), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class TelemetrySink:
+    """Writer half of the schema. Creating a sink truncates the directory's
+    stream files (a rebuilt experiment gets a rebuilt stream, like the
+    apply-log writer) and writes the manifest immediately, so a crashed run
+    still leaves a validatable directory behind."""
+
+    def __init__(
+        self,
+        directory: str,
+        cfg: RaftConfig,
+        *,
+        seed: int,
+        batch: int,
+        window: int,
+        ring: int,
+        source: str = "driver",
+    ):
+        import jax
+
+        self.directory = directory
+        self.cfg = cfg
+        self.window = window
+        self.ring = ring
+        self._n_windows = 0
+        os.makedirs(directory, exist_ok=True)
+        backend = jax.default_backend()
+        manifest = {
+            "schema_version": TELEMETRY_SCHEMA_VERSION,
+            "source": source,
+            "created_unix": int(time.time()),
+            "config": dataclasses.asdict(cfg),
+            "config_hash": config_hash(cfg),
+            "seed": int(seed),
+            "batch": int(batch),
+            "window": int(window),
+            "ring": int(ring),
+            "jax_version": jax.__version__,
+            "backend": backend,
+        }
+        with open(self._path("manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.write("\n")
+        open(self._path("windows.jsonl"), "w").close()  # truncate the stream
+        # A rebuilt run must not inherit the previous run's violation
+        # recordings or rollup: stale flight_*.jsonl under a fresh manifest
+        # would misattribute another run's violations to this one.
+        for name in os.listdir(directory):
+            if (name.startswith("flight_") and name.endswith(".jsonl")) or (
+                name == "summary.json"
+            ):
+                os.remove(os.path.join(directory, name))
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    def append_windows(self, records) -> int:
+        """Fleet-aggregate a stacked WindowRecord (public layout: leaves
+        [B, n_windows, ...]) and append one JSONL line per window. Returns the
+        number of lines written. Aggregation is pure integer sums/mins/maxes,
+        so `metrics_report` can re-merge lines losslessly."""
+        start = np.asarray(records.start)  # [B, n_windows] (lockstep: rows equal)
+        fv = np.asarray(records.first_viol_tick, dtype=np.int64)
+        m = {f: np.asarray(getattr(records.metrics, f)) for f in records.metrics._fields}
+        n_windows = start.shape[1]
+        lines = []
+        for w in range(n_windows):
+            viol = m["violations"][:, w]
+            fvw = int(fv[:, w].min())
+            lines.append({
+                "window": self._n_windows + w,
+                "start": int(start[0, w]),
+                "ticks": int(m["ticks"][0, w]),
+                "violations": int(viol.sum()),
+                "violating_clusters": int((viol > 0).sum()),
+                "first_viol_tick": None if fvw == _NEVER else fvw,
+                "msgs": int(m["total_msgs"].astype(np.int64)[:, w].sum()),
+                "cmds": int(m["total_cmds"].astype(np.int64)[:, w].sum()),
+                "max_term": int(m["max_term"][:, w].max()),
+                "max_commit": int(m["max_commit"][:, w].max()),
+                "lat_sum": int(m["lat_sum"].astype(np.int64)[:, w].sum()),
+                "lat_cnt": int(m["lat_cnt"].astype(np.int64)[:, w].sum()),
+                "lat_excluded": int(m["lat_excluded"].astype(np.int64)[:, w].sum()),
+                "noop_blocked": int(m["noop_blocked"].astype(np.int64)[:, w].sum()),
+                "lm_skipped_pairs": int(
+                    m["lm_skipped_pairs"].astype(np.int64)[:, w].sum()
+                ),
+                "lat_hist": [
+                    int(x) for x in m["lat_hist"].astype(np.int64)[:, w].sum(axis=0)
+                ],
+            })
+        with open(self._path("windows.jsonl"), "a") as f:
+            for line in lines:
+                f.write(json.dumps(line) + "\n")
+        self._n_windows += n_windows
+        return n_windows
+
+    def write_flight(self, cluster: int, ticks, infos: StepInfo) -> str:
+        """Write one cluster's flight-recorder export (telemetry.export_cluster
+        output) as flight_<cluster>.jsonl: one line per captured tick carrying
+        every StepInfo field. Returns the path written."""
+        path = self._path(f"flight_{cluster}.jsonl")
+        fields = {f: np.asarray(getattr(infos, f)) for f in infos._fields}
+        with open(path, "w") as f:
+            for i, t in enumerate(np.asarray(ticks)):
+                row = {"tick": int(t)}
+                for name, arr in fields.items():
+                    v = arr[i]
+                    row[name] = (
+                        [int(x) for x in v] if v.ndim else (int(v) if v.dtype != bool else bool(v))
+                    )
+                f.write(json.dumps(row) + "\n")
+        return path
+
+    def write_summary(self, summary: dict) -> str:
+        """End-of-run rollup (FleetSummary._asdict() + caller extras)."""
+        path = self._path("summary.json")
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return path
+
+
+def validate(directory: str) -> list[str]:
+    """Check a telemetry directory against the schema. Returns a list of
+    human-readable problems ([] = valid). Deliberately dependency-free (no
+    jsonschema in the image): the schema IS this function plus the field
+    tuples above."""
+    errors = []
+    man_path = os.path.join(directory, "manifest.json")
+    if not os.path.isfile(man_path):
+        return [f"missing manifest.json in {directory}"]
+    try:
+        with open(man_path) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError) as ex:
+        return [f"manifest.json unreadable: {ex}"]
+    for k in MANIFEST_FIELDS:
+        if k not in man:
+            errors.append(f"manifest.json: missing field {k!r}")
+    if man.get("schema_version") != TELEMETRY_SCHEMA_VERSION:
+        errors.append(
+            f"manifest.json: schema_version {man.get('schema_version')!r}, "
+            f"expected {TELEMETRY_SCHEMA_VERSION}"
+        )
+    if "config" in man:
+        try:
+            cfg = RaftConfig(**man["config"])
+            if "config_hash" in man and config_hash(cfg) != man["config_hash"]:
+                errors.append("manifest.json: config_hash does not match config")
+        except (TypeError, AssertionError) as ex:
+            errors.append(f"manifest.json: config does not load: {ex}")
+
+    win_path = os.path.join(directory, "windows.jsonl")
+    if not os.path.isfile(win_path):
+        errors.append("missing windows.jsonl")
+        return errors
+    prev_idx, prev_end = -1, None
+    with open(win_path) as f:
+        for ln, raw in enumerate(f, 1):
+            try:
+                row = json.loads(raw)
+            except json.JSONDecodeError as ex:
+                errors.append(f"windows.jsonl:{ln}: not JSON: {ex}")
+                continue
+            for k in WINDOW_FIELDS:
+                if not isinstance(row.get(k), int):
+                    errors.append(f"windows.jsonl:{ln}: field {k!r} missing or non-int")
+            fv = row.get("first_viol_tick")
+            if fv is not None and not isinstance(fv, int):
+                errors.append(f"windows.jsonl:{ln}: first_viol_tick must be int or null")
+            hist = row.get("lat_hist")
+            if (
+                not isinstance(hist, list)
+                or len(hist) != LAT_HIST_BINS
+                or not all(isinstance(x, int) and x >= 0 for x in hist)
+            ):
+                errors.append(
+                    f"windows.jsonl:{ln}: lat_hist must be {LAT_HIST_BINS} non-negative ints"
+                )
+            if isinstance(row.get("window"), int):
+                if row["window"] != prev_idx + 1:
+                    errors.append(
+                        f"windows.jsonl:{ln}: window index {row['window']} "
+                        f"(expected {prev_idx + 1})"
+                    )
+                prev_idx = row["window"]
+            if (
+                isinstance(row.get("start"), int)
+                and isinstance(row.get("ticks"), int)
+            ):
+                if row["ticks"] < 1:
+                    errors.append(f"windows.jsonl:{ln}: ticks must be >= 1")
+                # Windows must advance monotonically without overlap. Gaps ARE
+                # legal: ticks stepped outside run() (e.g. Session.offer) are
+                # not windowed.
+                if prev_end is not None and row["start"] < prev_end:
+                    errors.append(
+                        f"windows.jsonl:{ln}: start {row['start']} overlaps "
+                        f"previous window (ends at {prev_end})"
+                    )
+                prev_end = row["start"] + row["ticks"]
+
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("flight_") and name.endswith(".jsonl")):
+            continue
+        with open(os.path.join(directory, name)) as f:
+            for ln, raw in enumerate(f, 1):
+                try:
+                    row = json.loads(raw)
+                except json.JSONDecodeError as ex:
+                    errors.append(f"{name}:{ln}: not JSON: {ex}")
+                    continue
+                missing = [k for k in ("tick", *StepInfo._fields) if k not in row]
+                if missing:
+                    errors.append(f"{name}:{ln}: missing fields {missing}")
+    return errors
+
+
+def read_windows(directory: str) -> list[dict]:
+    """Load windows.jsonl as a list of dicts (validation is separate)."""
+    with open(os.path.join(directory, "windows.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def read_manifest(directory: str) -> dict:
+    with open(os.path.join(directory, "manifest.json")) as f:
+        return json.load(f)

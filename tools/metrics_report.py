@@ -1,0 +1,266 @@
+"""Render and diff telemetry runs (the host sink's consumer).
+
+Reads the schema `utils/telemetry_sink.py` writes (driver --telemetry-dir,
+bench --telemetry-dir) and renders it for humans: a run header from the
+manifest, the merged run totals (losslessly re-summed from the integer window
+stream), a tail of the window table, and flight-recorder renderings via
+`sim/trace.info_lines`. `--diff` compares two runs -- either two telemetry
+directories or a telemetry directory against a bench artifact (BENCH_*.json /
+`python bench.py` output), so a fresh run can be checked against the recorded
+history without eyeballing raw JSON.
+
+    python tools/metrics_report.py out/telemetry                # summary table
+    python tools/metrics_report.py out/telemetry --validate     # schema check only
+    python tools/metrics_report.py out/telemetry --flight 7     # render a recording
+    python tools/metrics_report.py --diff out/a out/b
+    python tools/metrics_report.py --diff out/telemetry BENCH_r05.json --config config2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from raft_sim_tpu.parallel.mesh import _hist_percentile
+from raft_sim_tpu.types import LAT_HIST_BINS, StepInfo
+from raft_sim_tpu.utils import telemetry_sink as sink
+
+
+def _merge_windows(rows: list[dict]) -> dict:
+    """Fold the window stream back into run totals (exact: the stream carries
+    integer sums, so re-summing is lossless)."""
+    if not rows:
+        return {}
+    hist = np.zeros(LAT_HIST_BINS, np.int64)
+    tot = {k: 0 for k in ("violations", "msgs", "cmds", "lat_sum", "lat_cnt",
+                          "lat_excluded", "noop_blocked", "lm_skipped_pairs",
+                          "ticks")}
+    first_viol = None
+    mx = {"max_term": 0, "max_commit": 0}
+    for r in rows:
+        for k in tot:
+            tot[k] += r[k]
+        for k in mx:
+            mx[k] = max(mx[k], r[k])
+        hist += np.asarray(r["lat_hist"], np.int64)
+        if first_viol is None and r.get("first_viol_tick") is not None:
+            first_viol = r["first_viol_tick"]
+    out = tot | mx
+    out["first_viol_tick"] = first_viol
+    out["lat_p50"] = _hist_percentile(hist, 0.50)
+    out["lat_p95"] = _hist_percentile(hist, 0.95)
+    out["lat_p99"] = _hist_percentile(hist, 0.99)
+    out["mean_commit_latency"] = (
+        round(tot["lat_sum"] / tot["lat_cnt"], 3) if tot["lat_cnt"] else None
+    )
+    return out
+
+
+def load_run(path: str, config: str | None = None) -> tuple[str, dict]:
+    """(label, comparable-metrics dict) from a telemetry directory OR a bench
+    JSON artifact (BENCH_*.json / `python bench.py` stdout saved to a file).
+    For bench artifacts, `config` picks the matrix row (default: the headline
+    workload)."""
+    if os.path.isdir(path):
+        # Same gate as the report path: a crash-truncated or malformed
+        # directory gets the INVALID listing, not a raw traceback.
+        errors = sink.validate(path)
+        if errors:
+            raise SystemExit(
+                f"{path}: invalid telemetry directory:\n  " + "\n  ".join(errors)
+            )
+        man = sink.read_manifest(path)
+        totals = _merge_windows(sink.read_windows(path))
+        summary_path = os.path.join(path, "summary.json")
+        if os.path.isfile(summary_path):
+            # End-of-run rollup keys (p50_stable_tick, ...) that the window
+            # stream alone cannot provide; window-derived totals win on clash.
+            with open(summary_path) as f:
+                totals = json.load(f) | totals
+        label = (
+            f"{path} [{man.get('source', '?')}: batch={man.get('batch')} "
+            f"seed={man.get('seed')} cfg={man.get('config_hash', '?')[:8]}]"
+        )
+        return label, totals
+    with open(path) as f:
+        data = json.load(f)
+    if "matrix" not in data and ("tail" in data or "parsed" in data):
+        # BENCH_r*.json wrapper: a capture of bench.py's stdout ({n, cmd, rc,
+        # tail, parsed}); the bench JSON line is `parsed` when present, else
+        # embedded in the tail text -- which is a BYTE-truncated capture, so
+        # recover whatever complete matrix rows survive in it.
+        if data.get("parsed"):
+            data = data["parsed"]
+        else:
+            import re
+
+            dec = json.JSONDecoder()
+            rows = {}
+            for mt in re.finditer(r'"(config[A-Za-z0-9_]*)":\s*\{', data.get("tail") or ""):
+                try:
+                    row, _ = dec.raw_decode((data.get("tail") or "")[mt.end() - 1:])
+                except json.JSONDecodeError:
+                    continue
+                if "cluster_ticks_per_s" in row:
+                    rows[mt.group(1)] = row
+            if not rows:
+                raise SystemExit(f"{path}: bench wrapper carries no recoverable rows")
+            data = {"matrix": rows, "workload": None}
+    if "matrix" in data:  # bench artifact
+        name = config or data.get("workload") or next(iter(data["matrix"]))
+        if name not in data["matrix"]:
+            raise SystemExit(f"{path}: no matrix row {name!r} "
+                             f"(have {sorted(data['matrix'])})")
+        row = dict(data["matrix"][name])
+        label = f"{path} [bench row {name}]"
+        # Align bench field names with the telemetry totals where they mean
+        # the same thing.
+        row["cmds"] = row.pop("total_cmds", None)
+        return label, row
+    raise SystemExit(f"{path}: neither a telemetry directory nor a bench artifact")
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.3f}".rstrip("0").rstrip(".")
+    return f"{v:,}"
+
+
+def report(directory: str, n_windows: int, out=sys.stdout) -> None:
+    man = sink.read_manifest(directory)
+    rows = sink.read_windows(directory)
+    totals = _merge_windows(rows)
+    cfg = man.get("config", {})
+    print(
+        f"telemetry run: {directory}\n"
+        f"  source={man.get('source')} schema=v{man.get('schema_version')} "
+        f"backend={man.get('backend')} jax={man.get('jax_version')}\n"
+        f"  config {man.get('config_hash')}: N={cfg.get('n_nodes')} "
+        f"CAP={cfg.get('log_capacity')} batch={man.get('batch')} "
+        f"seed={man.get('seed')} window={man.get('window')} "
+        f"ring={man.get('ring')}",
+        file=out,
+    )
+    if not rows:
+        print("  (no windows recorded)", file=out)
+        return
+    print(f"\n  {len(rows)} windows, {totals['ticks']} ticks per cluster", file=out)
+    keys = ("violations", "first_viol_tick", "msgs", "cmds", "max_commit",
+            "mean_commit_latency", "lat_p50", "lat_p95", "lat_p99",
+            "lat_excluded", "noop_blocked", "lm_skipped_pairs")
+    for k in keys:
+        print(f"  {k:22} {_fmt(totals.get(k)):>14}", file=out)
+
+    tail = rows[-n_windows:]
+    print(f"\n  last {len(tail)} windows:", file=out)
+    cols = ("window", "start", "ticks", "violations", "msgs", "cmds",
+            "lat_cnt", "lat_excluded")
+    print("  " + " ".join(f"{c:>12}" for c in cols), file=out)
+    for r in tail:
+        print("  " + " ".join(f"{_fmt(r[c]):>12}" for c in cols), file=out)
+
+    flights = sorted(
+        f for f in os.listdir(directory)
+        if f.startswith("flight_") and f.endswith(".jsonl")
+    )
+    if flights:
+        print(
+            f"\n  flight recordings: {', '.join(flights)} "
+            f"(render with --flight <cluster>)",
+            file=out,
+        )
+
+
+def render_flight(directory: str, cluster: int, out=sys.stdout) -> None:
+    """Rebuild the stacked StepInfo from a flight_<c>.jsonl and render it with
+    the same decoder the live trace path uses (sim/trace.info_lines)."""
+    from raft_sim_tpu.sim import trace
+
+    path = os.path.join(directory, f"flight_{cluster}.jsonl")
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    if not rows:
+        print(f"{path}: empty recording", file=out)
+        return
+    infos = StepInfo(*(np.asarray([r[f] for r in rows]) for f in StepInfo._fields))
+    ticks = [r["tick"] for r in rows]
+    print(f"flight recorder, cluster {cluster}: ticks {ticks[0]}..{ticks[-1]} "
+          f"({len(rows)} captured; frozen at the first violation)", file=out)
+    for t, line in zip(ticks, trace.info_lines(infos)):
+        # info_lines numbers from 0 within the stack; re-anchor at the
+        # recorder's absolute ticks.
+        print(f"tick {t:>8}  {line[line.index('leader='):]}", file=out)
+
+
+def diff(path_a: str, path_b: str, config: str | None, out=sys.stdout) -> None:
+    label_a, a = load_run(path_a, config)
+    label_b, b = load_run(path_b, config)
+    keys = [k for k in (
+        "violations", "cmds", "msgs", "max_commit", "p50_stable_tick",
+        "cluster_ticks_per_s", "mean_commit_latency", "p50_commit_latency",
+        "lat_p50", "lat_p95", "lat_p99", "lat_excluded", "noop_blocked",
+        "lm_skipped_pairs",
+    ) if k in a or k in b]
+    print(f"A: {label_a}\nB: {label_b}\n", file=out)
+    print(f"{'metric':22} {'A':>14} {'B':>14} {'delta':>14}", file=out)
+    for k in keys:
+        va, vb = a.get(k), b.get(k)
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+            d = _fmt(round(vb - va, 6))
+        else:
+            d = "-"
+        print(f"{k:22} {_fmt(va):>14} {_fmt(vb):>14} {d:>14}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="telemetry directory (or two with --diff)")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check the directory and exit (nonzero on errors)")
+    ap.add_argument("--diff", action="store_true",
+                    help="compare two runs (telemetry dirs or bench JSON files)")
+    ap.add_argument("--config", default=None,
+                    help="matrix row to read from a bench artifact (default: headline)")
+    ap.add_argument("--windows", type=int, default=8,
+                    help="window-table rows to show (default 8)")
+    ap.add_argument("--flight", type=int, default=None, metavar="CLUSTER",
+                    help="render flight_<CLUSTER>.jsonl via trace.info_lines")
+    args = ap.parse_args(argv)
+
+    if args.diff:
+        if len(args.paths) != 2:
+            ap.error("--diff needs exactly two paths")
+        diff(args.paths[0], args.paths[1], args.config)
+        return 0
+    if len(args.paths) != 1:
+        ap.error("need exactly one telemetry directory")
+    directory = args.paths[0]
+    errors = sink.validate(directory)
+    if errors:
+        for e in errors:
+            print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    if args.validate:
+        print(f"{directory}: schema v{sink.TELEMETRY_SCHEMA_VERSION} OK")
+        return 0
+    if args.flight is not None:
+        render_flight(directory, args.flight)
+        return 0
+    report(directory, args.windows)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # report piped into head/less and closed early
+        sys.exit(0)

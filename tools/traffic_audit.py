@@ -143,6 +143,34 @@ def _padded(shape, isize, batch):
     return math.prod(dims) * isize / batch
 
 
+def _telemetry_rows(cfg: RaftConfig, ring_k: int):
+    """(group, name, shape, dtype-size) rows for the telemetry carry legs
+    (sim/telemetry.py), taken from the real structures via eval_shape like
+    everything else: the windowed-aggregation leg is a second RunMetrics
+    (window-local accumulator) + the first-violation tick; the flight-recorder
+    leg is K stacked StepInfos + slot ticks + pos/frozen. All are scan-carry
+    components (read + write per tick), which is exactly why the ring must
+    stay small -- the audit prices the decision (docs/OBSERVABILITY.md)."""
+    metrics = jax.eval_shape(scan.init_metrics)
+    rows = [
+        ("telemetry", f"tel.wm.{f}", tuple(v.shape), v.dtype.itemsize)
+        for f, v in zip(metrics._fields, metrics)
+    ]
+    rows.append(("telemetry", "tel.first_viol", (), 4))
+    if ring_k > 0:
+        from raft_sim_tpu.sim import telemetry
+
+        rec = jax.eval_shape(lambda: telemetry.init_recorder(cfg, ring_k, 1))
+        for f, v in zip(rec.ring._fields, rec.ring):
+            rows.append(
+                ("telemetry", f"tel.ring.{f}", tuple(v.shape[:-1]), v.dtype.itemsize)
+            )
+        rows.append(("telemetry", "tel.ring.tick", (ring_k,), 4))
+        rows.append(("telemetry", "tel.pos", (), 4))
+        rows.append(("telemetry", "tel.frozen", (), 1))
+    return rows
+
+
 def audit(cfg: RaftConfig, batch: int):
     """Both layouts' per-cluster-tick byte totals. Carry leaves move twice per
     tick (read + write); inputs once (materialized from the key stream)."""
@@ -183,7 +211,8 @@ def _fmt_bytes(b):
     return f"{b / 1024:.2f} KiB" if b >= 1024 else f"{b:.0f} B"
 
 
-def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout):
+def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
+           telemetry_ring: int | None = None):
     a = audit(cfg, batch)
     w = bitplane.n_words(cfg.n_nodes)
     print(f"\n== {name}: N={cfg.n_nodes} (W={w}), CAP={cfg.log_capacity}, "
@@ -244,6 +273,30 @@ def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout):
             "compression can beat this",
             file=out,
         )
+    if telemetry_ring is not None:
+        # Observability overhead: the telemetry carry legs (window accumulator
+        # always; ring buffer at depth K) priced against the packed tick.
+        tel_rows = _telemetry_rows(cfg, telemetry_ring)
+        tel_log = sum(2 * _logical(s, i) for _, _, s, i in tel_rows)
+        tel_pad = sum(2 * _padded(s, i, batch) for _, _, s, i in tel_rows)
+        wm_rows = [r for r in tel_rows if not r[1].startswith("tel.ring")
+                   and r[1] not in ("tel.pos", "tel.frozen")]
+        wm_pad = sum(2 * _padded(s, i, batch) for _, _, s, i in wm_rows)
+        print(
+            f"telemetry carry legs (window accumulator"
+            + (f" + ring K={telemetry_ring}" if telemetry_ring else "")
+            + f"): {_fmt_bytes(tel_log)} logical / {_fmt_bytes(tel_pad)} padded "
+            f"per cluster-tick = +{100 * tel_pad / pp:.1f}% over the packed tick "
+            f"(windows alone: +{100 * wm_pad / pp:.1f}%)",
+            file=out,
+        )
+        res |= {
+            "telemetry_ring": telemetry_ring,
+            "telemetry_logical": tel_log,
+            "telemetry_padded": tel_pad,
+            "telemetry_window_only_padded": wm_pad,
+            "telemetry_overhead_frac": tel_pad / pp,
+        }
     return res
 
 
@@ -256,6 +309,10 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--top", type=int, default=8, help="largest planes listed")
     ap.add_argument("--json", action="store_true", help="emit one JSON line")
+    ap.add_argument("--telemetry-ring", type=int, default=None, metavar="K",
+                    help="also price the telemetry carry legs: the window "
+                         "accumulator plus a K-deep flight-recorder ring "
+                         "(K=0 prices windowed aggregation alone)")
     args = ap.parse_args(argv)
 
     # With --json the human tables go to stderr so stdout is exactly one
@@ -269,7 +326,8 @@ def main(argv=None) -> int:
             print(f"unknown preset {name!r}", file=sys.stderr)
             return 2
         cfg, batch = PRESETS[name]
-        results.append(report(name, cfg, batch, args.top, out=table_out))
+        results.append(report(name, cfg, batch, args.top, out=table_out,
+                              telemetry_ring=args.telemetry_ring))
     if args.json:
         print(json.dumps(results))
     return 0
