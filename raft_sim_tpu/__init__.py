@@ -38,10 +38,12 @@ from raft_sim_tpu.types import (
     init_batch,
     init_state,
 )
+from raft_sim_tpu.utils.checkpoint import FORMAT_VERSION as CHECKPOINT_FORMAT_VERSION
 from raft_sim_tpu.utils.config import PRESETS, RaftConfig
 
 __all__ = [
     "CANDIDATE",
+    "CHECKPOINT_FORMAT_VERSION",
     "FOLLOWER",
     "LEADER",
     "NIL",

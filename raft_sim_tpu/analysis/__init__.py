@@ -1,0 +1,30 @@
+"""Static analysis for raft_sim_tpu: the invariants the docstrings state,
+checked by machine.
+
+The simulator's perf and correctness story rides on conventions nothing used
+to enforce: the narrow-dtype policy of the [N, N] planes (types.index_dtype /
+ack_dtype), the integer-only protocol path, the loop-invariant scan-carry legs
+XLA must be allowed to elide (docs/PERF.md, round-4 lesson), the
+bump-_FORMAT_VERSION-on-field-change checkpoint convention, and the tier-1
+compile budget (~15-40 s per distinct scan program on CPU). This package
+checks all of them statically -- lowering is tracing only, no XLA compile, so
+the full gate runs in well under two minutes on CPU:
+
+  Pass A (`jaxpr_audit`)  lowers the real step/scan programs per config tier
+                          and walks the jaxprs (float ops, plane widening,
+                          carry passthrough + dtypes, large constants, the
+                          recompile-fork guard).
+  Pass B (`ast_lint`)     AST rules over the package source (traced branches,
+                          float literals) plus the contract cross-checks
+                          (types.py dtype comments, checkpoint fingerprint and
+                          serialization round trip).
+
+Findings are schema'd JSON (`findings`, same idiom as the telemetry sink);
+intentional exceptions carry one-line justifications in
+`analysis/waivers.json`. CLI: `python tools/check.py --all` (CI runs it before
+the tier-1 tests); rule catalogue and how-to-add-a-rule: docs/ANALYSIS.md.
+"""
+
+from raft_sim_tpu.analysis import ast_lint, findings, jaxpr_audit, policy, run
+
+__all__ = ["ast_lint", "findings", "jaxpr_audit", "policy", "run"]

@@ -1,0 +1,441 @@
+"""Pass A: lower the real step/scan programs and audit their jaxprs.
+
+Lowering is tracing only -- no XLA compile, so the whole pass runs in seconds
+on CPU even for the N=51 tier (`jax.make_jaxpr` over `raft.step`,
+`raft_batched.step_b`, and the jitted `scan.simulate`). The rules encode the
+invariants docs/PERF.md shows being lost silently:
+
+  float-op             no floating-point primitive anywhere in a step kernel:
+                       the protocol state path is all-integer by design
+                       (types.py); a float sneaking in (a mean, a /, an
+                       accidental promotion) is a dtype-discipline break AND a
+                       perf hazard.
+  plane-widening       no convert_element_type that widens an [N, N]-shaped
+                       plane from its policy narrow dtype (int8/int16,
+                       types.index_dtype / ack_dtype) into a wider type that
+                       persists -- widening straight into a reduction
+                       (sum/min/max accumulators) is the one legal form.
+  carry-dtype          the scan carry's state planes enter and leave the tick
+                       at the policy dtypes (a dropped `.astype(...)` at a
+                       plane rebuild shows up here, not in a benchmark).
+  carry-passthrough    every loop-invariant carry leg for the config
+                       (policy.invariant_leaves) is passed through the scan
+                       body UNTOUCHED -- var identity in the body jaxpr. XLA
+                       elides untouched legs from the per-tick HBM round trip;
+                       rewriting one as fresh values measurably regressed
+                       config3 by ~16% in round 4 (docs/PERF.md).
+  large-constant       no baked-in constant above a size threshold: a big
+                       closed-over table silently bloats every executable and
+                       usually means something meant to be computed or carried.
+  recompile-fork       tunable-only config changes (fault probabilities,
+                       cadences, timer values) must NOT change the lowered
+                       program's structure: each (base, variant) pair in
+                       FORK_PAIRS lowers the full scan program both ways and
+                       compares structural hashes. A Python branch on a tuned
+                       value (`if cfg.drop_prob > 0.2: <other algorithm>`)
+                       forks one compiled program per sweep point and melts
+                       the tier-1 compile budget (~15-40 s per distinct scan
+                       program on CPU); this rule fails it statically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu import types as rst_types
+from raft_sim_tpu.analysis import policy
+from raft_sim_tpu.analysis.findings import Finding
+from raft_sim_tpu.utils.config import PRESETS, RaftConfig
+
+# Every rule slug this pass can emit (run.run_all scopes stale-waiver
+# detection to the passes that actually ran).
+RULES = frozenset({
+    "float-op", "plane-widening", "carry-dtype", "carry-passthrough",
+    "large-constant", "recompile-fork",
+})
+
+# Reduction primitives a widening convert may legally feed: the widened plane
+# is an accumulator XLA fuses into the reduce, never a materialized tensor.
+REDUCERS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_and", "reduce_or",
+    "reduce_prod", "argmax", "argmin",
+})
+
+# Baked-in constants above this are flagged (rule large-constant). The largest
+# legitimate consts today are the [N, N] eye / [N, W] bit-weight planes --
+# ~2.6 KB at N=51; anything past 64 KiB is a table that should be computed,
+# carried, or fed as an input.
+LARGE_CONST_BYTES = 64 * 1024
+
+# Scan-program shape used for audits: small batch/ticks keep tracing fast and
+# have no effect on the audited structure (shapes scale, programs don't).
+_AUDIT_BATCH = 8
+_AUDIT_TICKS = 32
+
+# (preset, replacements) pairs for rule recompile-fork: every replacement is a
+# pure tuning-knob change (probabilities, cadences, horizons) that must lower
+# to a structurally identical scan program. Values are chosen to stay on the
+# same side of every structural gate (> 0 checks, dtype ceilings like
+# ack_age_sat's int8 tier and index_dtype's capacity tiers).
+FORK_PAIRS: tuple[tuple[str, dict], ...] = (
+    ("config2", {"client_interval": 12}),
+    ("config3", {"heartbeat_ticks": 4, "ack_timeout_ticks": 16}),
+    ("config4", {"drop_prob": 0.23, "clock_skew_prob": 0.13}),
+    ("config5", {"partition_prob": 0.4}),
+    ("config6", {"crash_prob": 0.2, "drop_prob": 0.15}),
+    ("config6r", {"client_interval": 8, "crash_down_ticks": 10}),
+)
+
+
+# ---------------------------------------------------------------- program zoo
+
+
+def _step_avals(cfg: RaftConfig, batch: int | None):
+    state, inputs, _ = policy.state_avals(cfg)
+    if batch is not None:
+        addb = lambda x: jax.ShapeDtypeStruct(tuple(x.shape) + (batch,), x.dtype)
+        state = jax.tree.map(addb, state)
+        inputs = jax.tree.map(addb, inputs)
+    return state, inputs
+
+
+@functools.lru_cache(maxsize=None)
+def step_jaxpr(cfg: RaftConfig, batched: bool = False):
+    """ClosedJaxpr of one tick: `raft.step` (vmap form, per-cluster shapes) or
+    `raft_batched.step_b` (batch-minor, trailing batch axis). Cached per
+    (cfg, form): tracing dominates the gate's runtime and the rules, the fork
+    guard, and the golden tests all want the same programs."""
+    from raft_sim_tpu.models import raft, raft_batched
+
+    if batched:
+        state, inputs = _step_avals(cfg, _AUDIT_BATCH)
+        fn = functools.partial(raft_batched.step_b, cfg)
+    else:
+        state, inputs = _step_avals(cfg, None)
+        fn = functools.partial(raft.step, cfg)
+    return jax.make_jaxpr(fn)(state, inputs)
+
+
+@functools.lru_cache(maxsize=None)
+def scan_jaxpr(cfg: RaftConfig, batch: int = _AUDIT_BATCH, ticks: int = _AUDIT_TICKS):
+    """ClosedJaxpr of the full batched run (`scan.simulate`: init + batch-minor
+    scan), traced through its jit wrapper. Cached: the per-tier rules and the
+    recompile-fork guard audit the same base programs."""
+    from raft_sim_tpu.sim import scan
+
+    seed = jax.ShapeDtypeStruct((), jnp.int32)
+    return jax.make_jaxpr(lambda s: scan.simulate(cfg, s, batch, ticks))(seed)
+
+
+def programs(name: str, cfg: RaftConfig):
+    """The audited programs for one config tier: both step kernels plus the
+    full scan. Yields (program_name, closed_jaxpr, kind)."""
+    yield f"jaxpr:{name}/step", step_jaxpr(cfg, batched=False), "step"
+    yield f"jaxpr:{name}/step_b", step_jaxpr(cfg, batched=True), "step"
+    yield f"jaxpr:{name}/simulate", scan_jaxpr(cfg), "scan"
+
+
+# ------------------------------------------------------------- jaxpr walking
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        for sub in v if isinstance(v, (list, tuple)) else (v,):
+            if hasattr(sub, "jaxpr"):  # ClosedJaxpr
+                yield sub.jaxpr
+            elif hasattr(sub, "eqns"):  # raw Jaxpr
+                yield sub
+
+
+def iter_eqns(jaxpr):
+    """Every eqn of `jaxpr` and its nested sub-jaxprs (pjit/scan/cond bodies),
+    depth-first."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def iter_consts(closed):
+    """Every baked-in constant of a ClosedJaxpr, including nested bodies.
+    Yields (path-ish depth marker, const)."""
+    for c in closed.consts:
+        yield c
+    for eqn in iter_eqns(closed.jaxpr):
+        for v in eqn.params.values():
+            for sub in v if isinstance(v, (list, tuple)) else (v,):
+                if hasattr(sub, "consts"):
+                    for c in sub.consts:
+                        yield c
+
+
+def op_histogram(closed) -> dict[str, int]:
+    """Primitive counts bucketed by output dtype: `{"prim dtype": count}` over
+    the whole program including nested bodies. The golden-snapshot currency:
+    a new [N, N, B] materialization or a dtype flip shows up as a reviewable
+    count diff, not a benchmark surprise."""
+    hist: dict[str, int] = {}
+    for eqn in iter_eqns(closed.jaxpr):
+        out = eqn.outvars[0]
+        dt = str(out.aval.dtype) if hasattr(out.aval, "dtype") else "abstract"
+        key = f"{eqn.primitive.name} {dt}"
+        hist[key] = hist.get(key, 0) + 1
+    return hist
+
+
+def _param_digest(params) -> str:
+    """Stable rendering of an eqn's non-jaxpr params (axes, dimension
+    numbers, dtypes, paddings -- the structural knobs that do not show in
+    avals). Sub-jaxprs are replaced by a marker (they are walked separately);
+    only comparable within one process (callable reprs carry addresses)."""
+    parts = []
+    for k in sorted(params):
+        v = params[k]
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        if any(hasattr(s, "jaxpr") or hasattr(s, "eqns") for s in vals):
+            parts.append(f"{k}=<jaxpr>")
+        else:
+            parts.append(f"{k}={v!r}")
+    return ";".join(parts)
+
+
+def structural_hash(closed) -> str:
+    """Hash of the program's structure: the depth-first sequence of
+    (primitive, params, input avals, output avals). Literal VALUES are
+    excluded (a literal contributes only its shape/dtype via its aval), so
+    two lowerings that differ only in baked tuning constants --
+    probabilities, cadences, thresholds -- hash equal, while any change to
+    the op sequence, a shape, a dtype, or a primitive's structural params
+    (reduce axes, gather dimension numbers, paddings) forks the hash.
+    Process-local (param reprs may embed addresses): compare hashes from the
+    same run only."""
+    h = hashlib.sha256()
+    for eqn in iter_eqns(closed.jaxpr):
+        h.update(eqn.primitive.name.encode())
+        h.update(_param_digest(eqn.params).encode())
+        for v in (*eqn.invars, *eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                h.update(str((tuple(aval.shape), str(aval.dtype))).encode())
+    return h.hexdigest()[:16]
+
+
+def program_hash(closed) -> str:
+    """Cache-key-like hash: the full jaxpr text (literals included). Two
+    identical hashes => one jit compile can serve both."""
+    return hashlib.sha256(str(closed).encode()).hexdigest()[:16]
+
+
+# -------------------------------------------------------------------- rules
+
+
+def check_float_ops(program: str, closed) -> list[Finding]:
+    """Rule float-op: step kernels are all-integer by design."""
+    out = []
+    for eqn in iter_eqns(closed.jaxpr):
+        for v in (*eqn.invars, *eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "dtype"):
+                continue
+            if jnp.issubdtype(aval.dtype, jnp.floating):
+                out.append(Finding(
+                    rule="float-op",
+                    path=program,
+                    message=(
+                        f"float dtype {aval.dtype} at primitive "
+                        f"'{eqn.primitive.name}' (shape {tuple(aval.shape)}): "
+                        "the protocol-state path is integer-only (types.py)"
+                    ),
+                ))
+                break  # one finding per eqn is enough
+    return out
+
+
+def _has_nn_pair(shape, n: int) -> bool:
+    return any(shape[i] == n and shape[i + 1] == n for i in range(len(shape) - 1))
+
+
+def check_plane_widening(program: str, closed, cfg: RaftConfig) -> list[Finding]:
+    """Rule plane-widening: top-level convert_element_type eqns that widen an
+    [N, N]-shaped int8/int16 plane, unless every consumer is a reduction (the
+    widen-into-accumulator form XLA fuses away). Top level is where the
+    kernels' own `.astype` discipline lives; jnp-internal promotions in nested
+    bodies feed reductions by construction."""
+    n = cfg.n_nodes
+    consumers: dict = {}
+    for eqn in closed.jaxpr.eqns:
+        for v in eqn.invars:
+            if hasattr(v, "count"):
+                consumers.setdefault(v, []).append(eqn.primitive.name)
+    escaping = set(v for v in closed.jaxpr.outvars if hasattr(v, "count"))
+    out = []
+    for eqn in closed.jaxpr.eqns:
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src, dst = eqn.invars[0].aval, eqn.outvars[0].aval
+        if src.dtype not in (jnp.int8, jnp.int16):
+            continue
+        if dst.dtype.itemsize <= src.dtype.itemsize:
+            continue
+        if not _has_nn_pair(tuple(src.shape), n):
+            continue
+        cons = consumers.get(eqn.outvars[0], [])
+        if cons and all(c in REDUCERS for c in cons) and eqn.outvars[0] not in escaping:
+            continue
+        out.append(Finding(
+            rule="plane-widening",
+            path=program,
+            message=(
+                f"[N,N] plane widened {src.dtype} -> {dst.dtype} "
+                f"(shape {tuple(src.shape)}, consumers {cons or ['<returned>']}): "
+                "policy dtypes (types.index_dtype/ack_dtype) must persist; "
+                "widening is only legal straight into a reduction"
+            ),
+        ))
+    return out
+
+
+def _find_scan(jaxpr, num_carry: int):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan" and eqn.params["num_carry"] == num_carry:
+            return eqn
+        for sub in _sub_jaxprs(eqn):
+            found = _find_scan(sub, num_carry)
+            if found is not None:
+                return found
+    return None
+
+
+def check_carry_passthrough(program: str, closed, cfg: RaftConfig) -> list[Finding]:
+    """Rule carry-passthrough: in the run scan's body, every leg
+    policy.invariant_leaves names for this config must be the SAME var in and
+    out (identity passthrough -- XLA then elides it from the per-tick HBM
+    round trip). Also rule carry-dtype: carried state planes hold their policy
+    dtypes."""
+    names = policy.carry_leaf_names()
+    eqn = _find_scan(closed.jaxpr, len(names))
+    if eqn is None:
+        return [Finding(
+            rule="carry-passthrough",
+            path=program,
+            message=(
+                f"no scan with the expected {len(names)}-leg carry found; the "
+                "run-loop structure changed -- update analysis/policy.py's "
+                "carry template alongside it"
+            ),
+        )]
+    body = eqn.params["jaxpr"].jaxpr
+    nc, nk = eqn.params["num_consts"], eqn.params["num_carry"]
+    carry_in = body.invars[nc:nc + nk]
+    carry_out = body.outvars[:nk]
+    identity = {nm for nm, a, b in zip(names, carry_in, carry_out) if a is b}
+    out = []
+    for nm in sorted(policy.invariant_leaves(cfg)):
+        if nm not in identity:
+            out.append(Finding(
+                rule="carry-passthrough",
+                path=program,
+                message=(
+                    f"carry leg '{nm}' should be loop-invariant for this "
+                    "config but is rewritten inside the scan body: pass the "
+                    "old value through untouched so XLA elides its HBM round "
+                    "trip (docs/PERF.md, round-4 lesson)"
+                ),
+            ))
+    # carry-dtype: the narrow-plane policy, checked on the carried avals.
+    expect = {
+        "next_index": jnp.dtype(rst_types.index_dtype(cfg)),
+        "match_index": jnp.dtype(rst_types.index_dtype(cfg)),
+        "ack_age": jnp.dtype(rst_types.ack_dtype(cfg)),
+        "mb.a_match": jnp.dtype(rst_types.index_dtype(cfg)),
+        "mb.a_hint": jnp.dtype(rst_types.index_dtype(cfg)),
+        "mb.req_off": jnp.dtype(jnp.int8),
+        "mb.resp_kind": jnp.dtype(jnp.int8),
+        "votes": jnp.dtype(jnp.uint32),
+        "mb.pv_grant": jnp.dtype(jnp.uint32),
+    }
+    for nm, v in zip(names, carry_out):
+        want = expect.get(nm)
+        if want is not None and v.aval.dtype != want:
+            out.append(Finding(
+                rule="carry-dtype",
+                path=program,
+                message=(
+                    f"carried plane '{nm}' leaves the tick as {v.aval.dtype}, "
+                    f"policy dtype is {want} (types.py)"
+                ),
+            ))
+    return out
+
+
+def check_large_constants(program: str, closed) -> list[Finding]:
+    """Rule large-constant: baked-in arrays above LARGE_CONST_BYTES."""
+    out = []
+    for c in iter_consts(closed):
+        nbytes = getattr(c, "nbytes", 0)
+        if nbytes > LARGE_CONST_BYTES:
+            out.append(Finding(
+                rule="large-constant",
+                path=program,
+                message=(
+                    f"baked-in constant of {nbytes} bytes (shape "
+                    f"{getattr(c, 'shape', '?')}, dtype {getattr(c, 'dtype', '?')}) "
+                    f"exceeds {LARGE_CONST_BYTES} B: compute it, carry it, or "
+                    "feed it as an input instead of baking it into every "
+                    "executable"
+                ),
+            ))
+    return out
+
+
+def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
+    """Rule recompile-fork: each (preset, tuning replacement) pair must lower
+    to structurally identical full-scan programs."""
+    out = []
+    for name, repl in pairs:
+        base, _ = PRESETS[name]
+        variant = dataclasses.replace(base, **repl)
+        h_base = structural_hash(scan_jaxpr(base))
+        h_var = structural_hash(scan_jaxpr(variant))
+        if h_base != h_var:
+            out.append(Finding(
+                rule="recompile-fork",
+                path=f"jaxpr:{name}/simulate",
+                message=(
+                    f"tuning-only change {repl} forked the lowered program "
+                    f"structure ({h_base} -> {h_var}): a Python branch or a "
+                    "shape now depends on a tuned value, so every sweep point "
+                    "would recompile (~15-40 s each on CPU, tier-1 budget)"
+                ),
+            ))
+    return out
+
+
+# --------------------------------------------------------------- entry point
+
+# The config tiers Pass A audits by default: one per structural family --
+# plain (config3), wide + partitions + sampled log matching (config5),
+# client + log matching (config1), faults (config4), compaction + crash
+# (config6), redirect pipeline (config6r).
+AUDIT_CONFIGS = ("config1", "config3", "config4", "config5", "config6", "config6r")
+
+
+def run_pass(config_names=AUDIT_CONFIGS, fork_pairs=FORK_PAIRS) -> list[Finding]:
+    """The full jaxpr pass: per-tier program rules + the fork guard."""
+    out: list[Finding] = []
+    for name in config_names:
+        cfg, _ = PRESETS[name]
+        for prog, closed, kind in programs(name, cfg):
+            if kind == "step":
+                out.extend(check_float_ops(prog, closed))
+                out.extend(check_plane_widening(prog, closed, cfg))
+            else:
+                out.extend(check_carry_passthrough(prog, closed, cfg))
+            out.extend(check_large_constants(prog, closed))
+    out.extend(check_recompile_forks(fork_pairs))
+    return out

@@ -1,0 +1,232 @@
+"""The machine-readable form of the repo's dtype and carry-identity policy.
+
+`types.py` states every invariant this package enforces, but states it in
+prose: field comments like `# [N, N] index_dtype` carry the narrow-dtype
+policy, the docstrings carry the "loop-invariant carry legs stay untouched"
+rule (docs/PERF.md, round-4 lesson), and `utils/checkpoint.py`'s version log
+carries the bump-on-field-change convention. This module turns each of those
+into data the two analysis passes can check against:
+
+  - `parse_types_comments()` parses the `# [shape] dtype` trailing comments of
+    the `ClusterState` / `Mailbox` / `StepInfo` field declarations straight
+    out of the `types.py` source (so the comments themselves become a checked
+    contract, not decoration);
+  - `resolve_dtypes()` maps policy names (`index_dtype`, `ack_dtype`) to the
+    concrete dtypes `types.py` computes for a given config;
+  - `invariant_leaves()` names the scan-carry legs a config's tick must pass
+    through UNTOUCHED (the legs XLA elides from the per-tick HBM round trip;
+    `tools/traffic_audit.py` prices the same set, imported from here so the
+    two can never disagree);
+  - `schema_fingerprint()` hashes the serialized-pytree field sets against
+    the pin in `utils/checkpoint._SCHEMA_FINGERPRINT`.
+
+Nothing here runs a simulation; the heaviest call is `jax.eval_shape`.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import inspect
+import re
+
+import jax
+import jax.numpy as jnp
+
+from raft_sim_tpu import types as rst_types
+from raft_sim_tpu.sim.scan import RunMetrics
+from raft_sim_tpu.types import ClusterState, Mailbox, StepInfo
+from raft_sim_tpu.utils.config import RaftConfig
+
+# Dtype tokens legal in a types.py field comment: either a concrete dtype or
+# the name of a policy function in types.py that picks one per config.
+CONCRETE_DTYPES = ("bool", "int8", "int16", "int32", "int64", "uint8", "uint32")
+POLICY_DTYPES = ("index_dtype", "ack_dtype")
+
+# Leading-comment grammar: optional shape (`[N, W]` / `scalar`), one or more
+# dtype tokens separated by `/`, optionally a parenthesized policy name, then
+# free prose. Examples that must parse (all live in types.py today):
+#   # [N] int32 (starts at 1, core.clj:34)
+#   # [N, W] uint32; bit j of votes[i] = i holds a vote from j
+#   # [N, N] index_dtype; leader i's next index for peer j
+#   # [N(responder)] int16/int32 (index_dtype): acked index ...
+#   # scalar int32 global tick counter
+#   # bool: two leaders share a term
+_DTYPE_TOKEN = "|".join(CONCRETE_DTYPES + POLICY_DTYPES)
+_COMMENT_RE = re.compile(
+    r"^(?:\[(?P<shape>[^\]]*)\]|(?P<scalar>scalar))?\s*"
+    rf"(?P<dtypes>(?:{_DTYPE_TOKEN})(?:/(?:{_DTYPE_TOKEN}))*)"
+    rf"(?:\s*\((?P<policy>{'|'.join(POLICY_DTYPES)})\))?"
+)
+_FIELD_RE = re.compile(r"^\s*(\w+):\s*jax\.Array\s*#\s*(.*)$")
+
+
+class FieldSpec:
+    """One parsed field-comment contract: declared ndim (None = unchecked)
+    and the set of dtype tokens the comment admits."""
+
+    def __init__(self, name: str, line: int, ndim: int | None, dtypes: tuple[str, ...]):
+        self.name = name
+        self.line = line
+        self.ndim = ndim
+        self.dtypes = dtypes
+
+    def __repr__(self):  # test/debug readability only
+        return f"FieldSpec({self.name!r}, ndim={self.ndim}, dtypes={self.dtypes})"
+
+
+def parse_types_comments(source: str | None = None):
+    """Parse the dtype contracts out of types.py's field comments.
+
+    Returns ({class_name: {field: FieldSpec}}, problems) where `problems` is a
+    list of (line, message) for declarations whose comment does NOT parse --
+    an unparseable comment is itself a finding (the contract must stay
+    machine-readable).
+    """
+    if source is None:
+        source = inspect.getsource(rst_types)
+    tree = ast.parse(source)
+    lines = source.splitlines()
+    out: dict[str, dict[str, FieldSpec]] = {}
+    problems: list[tuple[int, str]] = []
+    for node in tree.body:
+        if not (isinstance(node, ast.ClassDef) and node.name in (
+            "ClusterState", "Mailbox", "StepInfo", "StepInputs"
+        )):
+            continue
+        fields: dict[str, FieldSpec] = {}
+        for lineno in range(node.lineno, node.end_lineno + 1):
+            m = _FIELD_RE.match(lines[lineno - 1])
+            if not m:
+                continue
+            name, comment = m.groups()
+            cm = _COMMENT_RE.match(comment.strip())
+            if not cm:
+                problems.append(
+                    (lineno, f"{node.name}.{name}: comment {comment!r} does not "
+                             "parse as `[shape] dtype` (see analysis/policy.py)")
+                )
+                continue
+            if cm.group("shape") is not None:
+                shape = cm.group("shape")
+                ndim = shape.count(",") + 1 if shape.strip() else 0
+            elif cm.group("scalar"):
+                ndim = 0
+            else:
+                ndim = None
+            dtypes = tuple(cm.group("dtypes").split("/"))
+            if cm.group("policy"):
+                dtypes = dtypes + (cm.group("policy"),)
+            fields[name] = FieldSpec(name, lineno, ndim, dtypes)
+        out[node.name] = fields
+    return out, problems
+
+
+def resolve_dtypes(spec: FieldSpec, cfg: RaftConfig) -> set[jnp.dtype]:
+    """The concrete dtypes a field comment admits under `cfg`. A policy token
+    narrows the concrete alternatives to the one the policy picks; concrete
+    tokens stand alone."""
+    policy = [t for t in spec.dtypes if t in POLICY_DTYPES]
+    if policy:
+        fns = {"index_dtype": rst_types.index_dtype, "ack_dtype": rst_types.ack_dtype}
+        return {jnp.dtype(fns[t](cfg)) for t in policy}
+    return {jnp.dtype(t) for t in spec.dtypes}
+
+
+def state_avals(cfg: RaftConfig):
+    """eval_shape'd (ClusterState, StepInputs, StepInfo) for one cluster --
+    the actual shapes/dtypes the comment contracts are checked against."""
+    from raft_sim_tpu.models import raft
+    from raft_sim_tpu.sim import faults
+
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    state = jax.eval_shape(lambda k: rst_types.init_state(cfg, k), key)
+    inputs = jax.eval_shape(lambda k: faults.make_inputs(cfg, k, jnp.int32(0)), key)
+    _, info = jax.eval_shape(lambda s, i: raft.step(cfg, s, i), state, inputs)
+    return state, inputs, info
+
+
+def invariant_leaves(cfg: RaftConfig) -> set[str]:
+    """Carry leaves the tick passes through UNTOUCHED for this config. XLA
+    elides loop-invariant scan-carry components from the per-tick HBM round
+    trip, so rewriting one as fresh values each tick is a measured perf
+    regression (docs/PERF.md, round-4 lesson) -- the jaxpr pass fails it
+    statically (rule `carry-passthrough`), and `tools/traffic_audit.py`
+    excludes the same set from its traffic totals. Names: state fields bare,
+    mailbox fields as `mb.<field>`."""
+    inv = set()
+    if not cfg.pre_vote:
+        inv |= {"mb.pv_grant", "heard_clock"}
+    if not cfg.compaction:
+        inv |= {
+            "mb.req_base", "mb.req_base_term", "mb.req_base_chk",
+            "log_base", "base_term", "base_chk",
+        }
+    if not cfg.client_redirect:
+        inv |= {"client_pend", "client_dst"}
+    if cfg.client_interval == 0:
+        inv |= {"lat_frontier"}
+    return inv
+
+
+def carry_leaf_names() -> list[str]:
+    """Flattened leaf names of the batch-minor scan carry (state, metrics), in
+    pytree flatten order -- the order of the scan body jaxpr's carry vars.
+    State fields bare, mailbox fields `mb.<f>`, metrics `metric.<f>`."""
+    names = []
+    for f in ClusterState._fields:
+        if f == "mailbox":
+            names.extend(f"mb.{m}" for m in Mailbox._fields)
+        else:
+            names.append(f)
+    names.extend(f"metric.{m}" for m in RunMetrics._fields)
+    return names
+
+
+# The fingerprint's canonical config: pinned EXPLICITLY (never defaults, so a
+# default change cannot silently move the fingerprint) in the int8 index tier.
+# Dtype-policy changes in other tiers ride the same code paths, and the
+# version log shows every historical bump changed names, rank, or a dtype
+# visible in this tier (v8/v13/v17/v18 were exactly such dtype moves).
+_FINGERPRINT_CFG = dict(n_nodes=5, log_capacity=32, max_entries_per_rpc=4)
+
+
+def schema_fingerprint() -> str:
+    """sha256 over the serialized-pytree schema: the ordered field names of
+    (ClusterState, Mailbox, RunMetrics) -- the exact structures
+    `utils/checkpoint.save` iterates -- plus each leaf's rank and dtype under
+    the pinned canonical config. Any field add/remove/rename/reorder, any
+    rank change, and any dtype move (the v8/v13/v17/v18 class of bump)
+    changes this, and the pin in `checkpoint._SCHEMA_FINGERPRINT` must be
+    refreshed ALONGSIDE a _FORMAT_VERSION bump (rule `checkpoint-version`)."""
+    from raft_sim_tpu.sim import scan
+
+    cfg = RaftConfig(**_FINGERPRINT_CFG)
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    state = jax.eval_shape(lambda k: rst_types.init_state(cfg, k), key)
+    metrics = jax.eval_shape(scan.init_metrics)
+    rows = []
+    for f in ClusterState._fields:
+        if f == "mailbox":
+            continue
+        v = getattr(state, f)
+        rows.append((f, len(v.shape), str(v.dtype)))
+    for f in Mailbox._fields:
+        v = getattr(state.mailbox, f)
+        rows.append((f"mb.{f}", len(v.shape), str(v.dtype)))
+    for f in RunMetrics._fields:
+        v = getattr(metrics, f)
+        rows.append((f"metric.{f}", len(v.shape), str(v.dtype)))
+    return hashlib.sha256(repr(rows).encode()).hexdigest()[:16]
+
+
+def expected_checkpoint_keys() -> set[str]:
+    """The npz key set `checkpoint.save` must produce for its field sets --
+    derived the same way save() derives it, so a serializer change that
+    drops or renames a key diverges from this and the round-trip check
+    (rule `checkpoint-serialization`) names it."""
+    keys = {"__version__", "seed", "config_json", "keys"}
+    keys |= {f"state_{f}" for f in ClusterState._fields if f != "mailbox"}
+    keys |= {f"mb_{f}" for f in Mailbox._fields}
+    keys |= {f"metrics_{f}" for f in RunMetrics._fields}
+    return keys

@@ -1,0 +1,55 @@
+"""Orchestration: run both passes, apply waivers, build the report.
+
+`tools/check.py` is the CLI face; this module is the library face (tests call
+it directly). The default waiver file is `analysis/waivers.json` next to this
+package -- intentional exceptions live there with one-line justifications
+(findings.py documents the format).
+"""
+
+from __future__ import annotations
+
+import os
+
+from raft_sim_tpu.analysis import ast_lint, findings as F, jaxpr_audit
+
+DEFAULT_WAIVERS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "waivers.json")
+
+
+def package_root() -> str:
+    """The raft_sim_tpu package directory (the AST pass's default root)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_all(
+    *,
+    do_ast: bool = True,
+    do_jaxpr: bool = True,
+    config_names=jaxpr_audit.AUDIT_CONFIGS,
+    waivers_path: str | None = DEFAULT_WAIVERS,
+):
+    """Run the selected passes. Returns (findings, unused_waivers, problems):
+    `problems` are waiver-file format errors (always fatal for the CLI -- a
+    typo'd waiver must not silently stop waiving)."""
+    found: list[F.Finding] = []
+    active_rules: set[str] = set()
+    if do_ast:
+        found.extend(ast_lint.run_pass(package_root()))
+        active_rules |= ast_lint.RULES
+    if do_jaxpr:
+        found.extend(jaxpr_audit.run_pass(config_names))
+        active_rules |= jaxpr_audit.RULES
+    unused: list[dict] = []
+    problems: list[str] = []
+    if waivers_path:
+        entries, problems = F.load_waivers(waivers_path)
+        unused = F.apply_waivers(found, entries)
+        # A waiver is only STALE if the pass owning its rule actually ran (a
+        # --jaxpr-only run must not condemn the AST pass's waivers). A rule
+        # no pass knows -- a typo -- is stale whenever the full gate ran.
+        full = do_ast and do_jaxpr
+        unused = [
+            w for w in unused
+            if w.get("rule") in active_rules
+            or (full and w.get("rule") not in (ast_lint.RULES | jaxpr_audit.RULES))
+        ]
+    return found, unused, problems

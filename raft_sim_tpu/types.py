@@ -22,6 +22,14 @@ popcounts). Node ids are 0-based with
 -1 as nil (the reference uses 1-based ids and `nil`, core.clj:31-38). Log indices are
 1-based counts like the reference/spec (entry i lives at array slot i-1; index 0
 means "no entry", log.clj:20-23).
+
+The `# [shape] dtype` comment on every field below is a CHECKED CONTRACT, not
+decoration: the static analyzer parses them (analysis/policy.py, rule
+`dtype-comment`) and verifies shape rank and dtype against the structures
+init_state/make_inputs/step actually build, across the policy tiers (the
+index_dtype/ack_dtype functions here ARE the policy). Keep them parseable --
+leading `[dims] dtype` (or `scalar dtype`), with `/`-separated alternatives
+resolved through the named policy function.
 """
 
 from __future__ import annotations

@@ -65,6 +65,22 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      instead of documented-away). ClusterState is unchanged.
 _FORMAT_VERSION = 19
 
+# The single exported source of truth for the on-disk format version
+# (re-exported as raft_sim_tpu.CHECKPOINT_FORMAT_VERSION). Everything that
+# writes or gates on checkpoint compatibility must read THIS, not a copy.
+FORMAT_VERSION = _FORMAT_VERSION
+
+# Fingerprint of the serialized pytree schema: (version, sha256 of the ordered
+# field names + leaf ranks/dtypes of ClusterState / Mailbox / RunMetrics under
+# the analyzer's pinned canonical config). The static analyzer
+# (raft_sim_tpu/analysis, rule `checkpoint-version`) recomputes the hash from
+# the live NamedTuples and fails when the field set changed without BOTH
+# bumping _FORMAT_VERSION (append a line to the version log above) and
+# refreshing this pin -- the convention the v2..v19 log always relied on,
+# now machine-checked. Refresh with:
+#     python -c "from raft_sim_tpu.analysis import policy; print(policy.schema_fingerprint())"
+_SCHEMA_FINGERPRINT = (19, "958f6e7a244df547")
+
 
 def _normalize(path: str) -> str:
     """np.savez appends '.npz' to bare paths; normalize so save and load agree."""
@@ -101,7 +117,17 @@ def load(path: str) -> tuple[RaftConfig, ClusterState, jax.Array, RunMetrics, in
     with np.load(_normalize(path)) as z:
         version = int(z["__version__"])
         if version != _FORMAT_VERSION:
-            raise ValueError(f"checkpoint format {version}, expected {_FORMAT_VERSION}")
+            direction = "older" if version < _FORMAT_VERSION else "newer"
+            raise ValueError(
+                f"checkpoint was written as format v{version}, but this build "
+                f"reads v{_FORMAT_VERSION} (the file is {direction} than the "
+                f"code). Checkpoints do not auto-migrate: the version log in "
+                f"raft_sim_tpu/utils/checkpoint.py names the field change(s) "
+                f"between v{min(version, _FORMAT_VERSION)} and "
+                f"v{max(version, _FORMAT_VERSION)}; either re-generate the "
+                f"checkpoint from its original (seed, config) with this build, "
+                f"or load it with the release that wrote v{version}."
+            )
         cfg = RaftConfig(**json.loads(bytes(z["config_json"]).decode()))
         mb = Mailbox(**{f: jax.numpy.asarray(z[f"mb_{f}"]) for f in Mailbox._fields})
         fields = {

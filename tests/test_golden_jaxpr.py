@@ -1,0 +1,125 @@
+"""Golden jaxpr snapshots + the compile-count regression pin.
+
+Two structural guards over the hot-path programs, both lowering-only (no XLA
+compile, so this module costs seconds, not scan-compile minutes):
+
+1. **Op-histogram snapshot** (tests/golden_jaxpr_hist.json): primitive counts
+   bucketed by output dtype for the N=5 (config3) and N=51 (config5) step
+   programs, both kernel forms. A hot-path regression -- a new [N, N, B]
+   materialization, a dtype flip, a lost fusion opportunity -- shows up as a
+   reviewable count diff instead of a benchmark surprise on the next chip
+   session. Counts are exact for a fixed jax version (recorded in the file);
+   under a different jax the exact comparison is skipped and only the
+   version-independent invariants (no float primitives) are asserted.
+
+   Regenerate after an INTENDED kernel change:
+       JAX_PLATFORMS=cpu python tests/test_golden_jaxpr.py --update
+
+2. **Compile-count pin**: the number of distinct jit lowerings the preset
+   matrix induces, for the step kernel and the full scan program. Every
+   distinct scan program costs ~15-40 s of tier-1 compile time on CPU
+   (ROADMAP's 870 s budget); this pin makes adding one a conscious, reviewed
+   bump instead of a silent budget leak. The fork-pair rule (analysis
+   rule recompile-fork, run in the tools/check.py gate) guards the other
+   direction: tuning-only config changes must NOT add programs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import pytest
+
+from raft_sim_tpu.analysis import jaxpr_audit as JA
+from raft_sim_tpu.utils.config import PRESETS
+
+GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden_jaxpr_hist.json")
+
+# The snapshotted step programs: (golden key, preset, batched kernel form).
+SNAPSHOT_PROGRAMS = (
+    ("config3/step", "config3", False),
+    ("config3/step_b", "config3", True),
+    ("config5/step", "config5", False),
+    ("config5/step_b", "config5", True),
+)
+
+# Distinct lowerings across the preset matrix (8 presets, all structurally
+# distinct today: different N/CAP/E shapes or different feature gates). Bump
+# ONLY with a new preset or a deliberate program fork -- each distinct scan
+# program is ~15-40 s of tier-1 compile budget.
+PINNED_STEP_LOWERINGS = 8
+PINNED_SCAN_LOWERINGS = 8
+
+
+def _histograms():
+    out = {}
+    for key, preset, batched in SNAPSHOT_PROGRAMS:
+        cfg, _ = PRESETS[preset]
+        out[key] = JA.op_histogram(JA.step_jaxpr(cfg, batched=batched))
+    return out
+
+
+def test_golden_op_histograms():
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    actual = _histograms()
+    # Version-independent invariant first: the step programs are float-free.
+    for key, hist in actual.items():
+        floats = [k for k in hist if "float" in k or "bfloat" in k]
+        assert not floats, f"{key}: float primitives in the step program: {floats}"
+    if golden["jax_version"] != jax.__version__:
+        pytest.skip(
+            f"golden recorded under jax {golden['jax_version']}, running "
+            f"{jax.__version__}: exact op counts are version-specific"
+        )
+    for key, hist in actual.items():
+        want = golden["programs"][key]
+        if hist != want:
+            diff = {
+                k: (want.get(k, 0), hist.get(k, 0))
+                for k in sorted(set(want) | set(hist))
+                if want.get(k, 0) != hist.get(k, 0)
+            }
+            raise AssertionError(
+                f"{key}: op histogram drifted (golden, actual): {diff}\n"
+                "If the kernel change is intended, regenerate with:\n"
+                "  JAX_PLATFORMS=cpu python tests/test_golden_jaxpr.py --update"
+            )
+
+
+def test_compile_count_pin():
+    step_hashes = set()
+    scan_hashes = set()
+    for name, (cfg, _) in PRESETS.items():
+        step_hashes.add(JA.program_hash(JA.step_jaxpr(cfg, batched=True)))
+        scan_hashes.add(JA.program_hash(JA.scan_jaxpr(cfg)))
+    assert len(step_hashes) <= PINNED_STEP_LOWERINGS, (
+        f"{len(step_hashes)} distinct step_b lowerings across the preset "
+        f"matrix (pinned {PINNED_STEP_LOWERINGS}): a config that should share "
+        "a program now forks one. Each distinct scan program costs ~15-40 s "
+        "of tier-1 compile budget -- deduplicate, or bump the pin consciously."
+    )
+    assert len(scan_hashes) <= PINNED_SCAN_LOWERINGS, (
+        f"{len(scan_hashes)} distinct scan lowerings across the preset matrix "
+        f"(pinned {PINNED_SCAN_LOWERINGS}); see PINNED_SCAN_LOWERINGS."
+    )
+
+
+def _update():
+    doc = {"jax_version": jax.__version__, "programs": _histograms()}
+    with open(GOLDEN_PATH, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {GOLDEN_PATH} under jax {jax.__version__}")
+
+
+if __name__ == "__main__":
+    if "--update" in sys.argv:
+        _update()
+    else:
+        print(__doc__)

@@ -1,0 +1,102 @@
+"""Static-analysis gate: run the raft_sim_tpu invariant auditor.
+
+Two passes (raft_sim_tpu/analysis): Pass A lowers the real step/scan programs
+per config tier and audits the jaxprs (dtype discipline, loop-invariant carry,
+recompile forks); Pass B lints the package source (traced branches, float
+literals) and cross-checks the types.py dtype comments and the checkpoint
+version pin against the live structures. Lowering only -- no XLA compile --
+so the whole gate runs in seconds on CPU. CI runs it before the tier-1 tests.
+
+    python tools/check.py --all                  # both passes, text report
+    python tools/check.py --all --format=json    # machine-readable (CI artifact)
+    python tools/check.py --ast                  # source + contract rules only
+    python tools/check.py --jaxpr --configs config3,config5
+
+Exit codes: 0 = no unwaived findings, 1 = unwaived findings (or a stale /
+malformed waiver file), 2 = usage error. Intentional exceptions live in
+raft_sim_tpu/analysis/waivers.json with one-line justifications
+(docs/ANALYSIS.md documents the format and the rule catalogue).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--all", action="store_true", help="run both passes (default)")
+    ap.add_argument("--ast", action="store_true", help="Pass B only (AST + contracts)")
+    ap.add_argument("--jaxpr", action="store_true", help="Pass A only (jaxpr audit)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--configs",
+        default=None,
+        help="comma-separated preset names for the jaxpr pass "
+             "(default: the analysis.jaxpr_audit.AUDIT_CONFIGS tiers)",
+    )
+    ap.add_argument(
+        "--waivers",
+        default=None,
+        help="waiver file (default: raft_sim_tpu/analysis/waivers.json); "
+             "'none' disables waiving",
+    )
+    args = ap.parse_args(argv)
+
+    from raft_sim_tpu.analysis import jaxpr_audit, run
+    from raft_sim_tpu.analysis import findings as F
+    from raft_sim_tpu.utils.config import PRESETS
+
+    do_ast = args.all or args.ast or not (args.ast or args.jaxpr)
+    do_jaxpr = args.all or args.jaxpr or not (args.ast or args.jaxpr)
+    config_names = jaxpr_audit.AUDIT_CONFIGS
+    if args.configs:
+        config_names = tuple(c.strip() for c in args.configs.split(","))
+        unknown = [c for c in config_names if c not in PRESETS]
+        if unknown:
+            print(f"unknown preset(s) {unknown}", file=sys.stderr)
+            return 2
+    waivers_path = run.DEFAULT_WAIVERS
+    if args.waivers:
+        waivers_path = None if args.waivers == "none" else args.waivers
+
+    t0 = time.time()
+    found, unused, problems = run.run_all(
+        do_ast=do_ast, do_jaxpr=do_jaxpr,
+        config_names=config_names, waivers_path=waivers_path,
+    )
+    elapsed = time.time() - t0
+    unwaived = [f for f in found if not f.waived]
+
+    if args.format == "json":
+        doc = F.report(
+            found,
+            unused_waivers=unused,
+            extras={"elapsed_s": round(elapsed, 2), "waiver_problems": problems},
+        )
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in found:
+            tag = f"WAIVED ({f.waiver_reason})" if f.waived else "FAIL"
+            print(f"[{tag}] {f.rule} {f.location()}\n    {f.message}")
+        for w in unused:
+            print(f"[STALE WAIVER] {w.get('rule')} {w.get('path')}: "
+                  f"matched no finding -- remove it ({w.get('reason')})")
+        for p in problems:
+            print(f"[WAIVER FILE ERROR] {p}")
+        print(
+            f"{len(found)} finding(s): {len(unwaived)} unwaived, "
+            f"{len(found) - len(unwaived)} waived, {len(unused)} stale waiver(s) "
+            f"({elapsed:.1f}s)"
+        )
+    return 1 if (unwaived or unused or problems) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

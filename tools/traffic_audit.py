@@ -46,6 +46,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
+from raft_sim_tpu.analysis.policy import invariant_leaves
 from raft_sim_tpu.ops import bitplane
 from raft_sim_tpu.sim import faults, scan
 from raft_sim_tpu.types import init_state
@@ -64,25 +65,13 @@ RECORDED_TICKS_PER_S = {
 _SUBLANE = {4: 8, 2: 16, 1: 32}
 
 
-def _invariant_leaves(cfg: RaftConfig) -> set[str]:
-    """Carry leaves the tick passes through UNTOUCHED for this config: XLA
-    elides loop-invariant scan-carry components from the per-tick HBM round
-    trip (the round-4 lesson recorded in docs/PERF.md -- re-writing them as
-    fresh zeros each tick measurably regressed config3), so they are excluded
-    from the traffic totals."""
-    inv = set()
-    if not cfg.pre_vote:
-        inv |= {"mb.pv_grant", "heard_clock"}
-    if not cfg.compaction:
-        inv |= {
-            "mb.req_base", "mb.req_base_term", "mb.req_base_chk",
-            "log_base", "base_term", "base_chk",
-        }
-    if not cfg.client_redirect:
-        inv |= {"client_pend", "client_dst"}
-    if cfg.client_interval == 0:
-        inv |= {"lat_frontier"}
-    return inv
+# Loop-invariant carry legs (excluded from the traffic totals: XLA elides
+# them from the per-tick HBM round trip -- the round-4 lesson recorded in
+# docs/PERF.md). Single-sourced from analysis/policy.py, where the jaxpr pass
+# (rule carry-passthrough) STATICALLY enforces that the legs named there are
+# in fact passed through the scan body untouched -- so this audit and the
+# analyzer can never disagree about which legs are free.
+_invariant_leaves = invariant_leaves
 
 
 def _leaf_rows(cfg: RaftConfig):
