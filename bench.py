@@ -86,7 +86,21 @@ def _telemetry_window(ticks: int) -> int:
 
 def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
           quality_seeds: int = 3, telemetry_dir: str | None = None,
-          config_name: str = "custom") -> dict:
+          config_name: str = "custom", scenario=None) -> dict:
+    # `scenario` (a ScenarioProgram) reroutes every run through the
+    # scenario-engine input path -- the program's genome broadcast over the
+    # fleet -- so the row prices the genome-table reads and the
+    # always-traced fault lattice against the scalar path's numbers
+    # (docs/PERF.md "scenario path" has the standing verdict).
+    if scenario is not None:
+        from raft_sim_tpu.scenario import genome as genome_mod
+
+        g = genome_mod.broadcast(scenario.genome, batch)
+        seg_len = scenario.seg_len
+        sim = lambda seed: scan.simulate_scenario(cfg, seed, batch, ticks, g, seg_len)
+    else:
+        g = seg_len = None
+        sim = lambda seed: scan.simulate(cfg, seed, batch, ticks)
     # Quality runs use FIXED seeds 0..quality_seeds-1 (reproducible across
     # invocations, comparable across commits) and their per-cluster metrics are
     # pooled, so the reported p50s sample quality_seeds x batch clusters instead
@@ -110,11 +124,12 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
                 batch=batch, window=window, ring=0, source="bench",
             )
             final, m, records, _ = telemetry.simulate_windowed(
-                cfg, qs, batch, ticks, window
+                cfg, qs, batch, ticks, window, genome=g,
+                seg_len=seg_len if seg_len is not None else 1,
             )
             sink.append_windows(jax.device_get(records))
         else:
-            final, m = scan.simulate(cfg, qs, batch, ticks)
+            final, m = sim(qs)
         pooled.append(jax.device_get(m))
     q_metrics = type(pooled[0])(
         *(np.concatenate([np.asarray(getattr(m, f)) for m in pooled])
@@ -125,7 +140,7 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
     best = float("inf")
     for r in range(1, repeats + 1):
         t0 = time.perf_counter()
-        final, metrics = scan.simulate(cfg, seed_base + r, batch, ticks)
+        final, metrics = sim(seed_base + r)
         # Time to a host copy, not block_until_ready (see module docstring).
         np.asarray(metrics.ticks)
         best = min(best, time.perf_counter() - t0)
@@ -155,6 +170,7 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
         "violations": s.total_violations,
         "noop_blocked": s.noop_blocked,
         "lm_skipped_pairs": s.lm_skipped_pairs,
+        "multi_leader": s.multi_leader,
         "quality_seeds": quality_seeds,
     }
 
@@ -172,7 +188,19 @@ def main() -> None:
                     help="also write each config's seed-0 quality run as a "
                          "telemetry directory (DIR/<config>/, the same schema "
                          "driver.py --telemetry-dir emits)")
+    ap.add_argument("--scenario", default=None, metavar="FILE",
+                    help="run the benched config(s) through the scenario-"
+                         "engine input path under this nemesis program "
+                         "(prices the genome-table reads; requires --preset)")
     args = ap.parse_args()
+
+    scenario = None
+    if args.scenario:
+        if not args.preset:
+            ap.error("--scenario requires --preset (one labeled row)")
+        from raft_sim_tpu.scenario import program as program_mod
+
+        scenario = program_mod.load(args.scenario, PRESETS[args.preset][0])
 
     names = (
         [args.preset]
@@ -200,7 +228,10 @@ def main() -> None:
         )
         print(f"bench {name}: batch={batch} ticks={ticks}...", file=sys.stderr)
         matrix[name] = bench(cfg, batch, ticks, args.repeats,
-                             telemetry_dir=args.telemetry_dir, config_name=name)
+                             telemetry_dir=args.telemetry_dir, config_name=name,
+                             scenario=scenario)
+        if scenario is not None:
+            matrix[name]["scenario"] = scenario.name
 
     # The headline is the north-star workload (config3) whenever it ran; benching a
     # different single preset labels itself via "workload" so vs_baseline is never

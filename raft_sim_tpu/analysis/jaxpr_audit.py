@@ -5,9 +5,12 @@ on CPU even for the N=51 tier (`jax.make_jaxpr` over `raft.step`,
 `raft_batched.step_b`, and the jitted `scan.simulate`). The rules encode the
 invariants docs/PERF.md shows being lost silently:
 
-  float-op             no floating-point primitive anywhere in a step kernel:
-                       the protocol state path is all-integer by design
-                       (types.py); a float sneaking in (a mean, a /, an
+  float-op             no floating-point primitive anywhere in an audited
+                       program -- step kernels AND full scan programs: the
+                       protocol state path is all-integer by design
+                       (types.py), and since the uint32 threshold-compare
+                       refactor of sim/faults.py the per-tick input pipeline
+                       is too. A float sneaking in (a mean, a /, an
                        accidental promotion) is a dtype-discipline break AND a
                        perf hazard.
   plane-widening       no convert_element_type that widens an [N, N]-shaped
@@ -76,6 +79,13 @@ LARGE_CONST_BYTES = 64 * 1024
 # have no effect on the audited structure (shapes scale, programs don't).
 _AUDIT_BATCH = 8
 _AUDIT_TICKS = 32
+# Canonical scenario-program shape for the audited genome path: S segments of
+# SEG_LEN ticks. S/seg_len are shape-like statics (a different S is a new
+# program, like a different batch); genome VALUES are traced and can never
+# fork a compile -- which is the whole point, and what the scenario fork
+# check below pins.
+_AUDIT_SEGMENTS = 2
+_AUDIT_SEG_LEN = 16
 
 # (preset, replacements) pairs for rule recompile-fork: every replacement is a
 # pure tuning-knob change (probabilities, cadences, horizons) that must lower
@@ -132,12 +142,45 @@ def scan_jaxpr(cfg: RaftConfig, batch: int = _AUDIT_BATCH, ticks: int = _AUDIT_T
     return jax.make_jaxpr(lambda s: scan.simulate(cfg, s, batch, ticks))(seed)
 
 
+def _genome_avals(batch: int, s_count: int):
+    from raft_sim_tpu.scenario.genome import ScenarioGenome, leaf_dtype
+
+    return ScenarioGenome(**{
+        f: jax.ShapeDtypeStruct((batch, s_count), leaf_dtype(f))
+        for f in ScenarioGenome._fields
+    })
+
+
+@functools.lru_cache(maxsize=None)
+def scenario_scan_jaxpr(
+    cfg: RaftConfig,
+    batch: int = _AUDIT_BATCH,
+    ticks: int = _AUDIT_TICKS,
+    s_count: int = _AUDIT_SEGMENTS,
+    seg_len: int = _AUDIT_SEG_LEN,
+):
+    """ClosedJaxpr of the scenario-engine run (`scan.simulate_scenario`: the
+    genome input path, every fault mechanism traced). The genome enters as
+    `[B, S]` avals -- its VALUES are invisible to lowering, so one program
+    serves the whole heterogeneous fleet; the same carry template as the
+    plain scan (the genome rides the body as loop constants, never carry)."""
+    from raft_sim_tpu.sim import scan
+
+    seed = jax.ShapeDtypeStruct((), jnp.int32)
+    gen = _genome_avals(batch, s_count)
+    return jax.make_jaxpr(
+        lambda s, g: scan.simulate_scenario(cfg, s, batch, ticks, g, seg_len)
+    )(seed, gen)
+
+
 def programs(name: str, cfg: RaftConfig):
-    """The audited programs for one config tier: both step kernels plus the
-    full scan. Yields (program_name, closed_jaxpr, kind)."""
+    """The audited programs for one config tier: both step kernels, the full
+    scan, and the scenario (genome-path) scan. Yields
+    (program_name, closed_jaxpr, kind)."""
     yield f"jaxpr:{name}/step", step_jaxpr(cfg, batched=False), "step"
     yield f"jaxpr:{name}/step_b", step_jaxpr(cfg, batched=True), "step"
     yield f"jaxpr:{name}/simulate", scan_jaxpr(cfg), "scan"
+    yield f"jaxpr:{name}/scenario_simulate", scenario_scan_jaxpr(cfg), "scan"
 
 
 # ------------------------------------------------------------- jaxpr walking
@@ -395,24 +438,33 @@ def check_large_constants(program: str, closed) -> list[Finding]:
 
 def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
     """Rule recompile-fork: each (preset, tuning replacement) pair must lower
-    to structurally identical full-scan programs."""
+    to structurally identical programs -- for the plain scan AND the scenario
+    (genome-path) scan. The scenario check is the stronger claim: the genome
+    path exists so that fault-space sweeps are pure data, so ANY tuned-value
+    leak into its structure would resurrect exactly the per-point recompile
+    the scenario engine removes (one compile per genome/segment is the
+    failure mode ISSUE 4 forbids)."""
     out = []
     for name, repl in pairs:
         base, _ = PRESETS[name]
         variant = dataclasses.replace(base, **repl)
-        h_base = structural_hash(scan_jaxpr(base))
-        h_var = structural_hash(scan_jaxpr(variant))
-        if h_base != h_var:
-            out.append(Finding(
-                rule="recompile-fork",
-                path=f"jaxpr:{name}/simulate",
-                message=(
-                    f"tuning-only change {repl} forked the lowered program "
-                    f"structure ({h_base} -> {h_var}): a Python branch or a "
-                    "shape now depends on a tuned value, so every sweep point "
-                    "would recompile (~15-40 s each on CPU, tier-1 budget)"
-                ),
-            ))
+        for label, lower in (
+            ("simulate", scan_jaxpr),
+            ("scenario_simulate", scenario_scan_jaxpr),
+        ):
+            h_base = structural_hash(lower(base))
+            h_var = structural_hash(lower(variant))
+            if h_base != h_var:
+                out.append(Finding(
+                    rule="recompile-fork",
+                    path=f"jaxpr:{name}/{label}",
+                    message=(
+                        f"tuning-only change {repl} forked the lowered program "
+                        f"structure ({h_base} -> {h_var}): a Python branch or a "
+                        "shape now depends on a tuned value, so every sweep point "
+                        "would recompile (~15-40 s each on CPU, tier-1 budget)"
+                    ),
+                ))
     return out
 
 
@@ -426,13 +478,16 @@ AUDIT_CONFIGS = ("config1", "config3", "config4", "config5", "config6", "config6
 
 
 def run_pass(config_names=AUDIT_CONFIGS, fork_pairs=FORK_PAIRS) -> list[Finding]:
-    """The full jaxpr pass: per-tier program rules + the fork guard."""
+    """The full jaxpr pass: per-tier program rules + the fork guard. Since the
+    round-7 threshold-compare refactor (sim/faults.py) the ENTIRE input
+    pipeline is integer too, so the float-op rule runs on every audited
+    program -- scans included -- not just the step kernels."""
     out: list[Finding] = []
     for name in config_names:
         cfg, _ = PRESETS[name]
         for prog, closed, kind in programs(name, cfg):
+            out.extend(check_float_ops(prog, closed))
             if kind == "step":
-                out.extend(check_float_ops(prog, closed))
                 out.extend(check_plane_widening(prog, closed, cfg))
             else:
                 out.extend(check_carry_passthrough(prog, closed, cfg))

@@ -153,7 +153,14 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
     regression (docs/PERF.md, round-4 lesson) -- the jaxpr pass fails it
     statically (rule `carry-passthrough`), and `tools/traffic_audit.py`
     excludes the same set from its traffic totals. Names: state fields bare,
-    mailbox fields as `mb.<field>`."""
+    mailbox fields as `mb.<field>`.
+
+    The SAME set governs the scenario (genome-path) scan: a genome tunes only
+    inputs, never which carry legs a config's tick touches -- the structural
+    gates (pre_vote, compaction, client_redirect, client_interval > 0) stay
+    on RaftConfig precisely so this holds, and the jaxpr pass enforces it on
+    `scenario_simulate` programs too. The genome itself is scan CONSTS
+    (`scenario_genome_leaves`), not carry."""
     inv = set()
     if not cfg.pre_vote:
         inv |= {"mb.pv_grant", "heard_clock"}
@@ -167,6 +174,20 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
     if cfg.client_interval == 0:
         inv |= {"lat_frontier"}
     return inv
+
+
+def scenario_genome_leaves() -> list[tuple[str, str]]:
+    """(leaf name, dtype) of the ScenarioGenome fields, in field order -- the
+    scenario engine's input-side surface. Single-sourced here so the traffic
+    audit (`tools/traffic_audit.py --scenario`) prices exactly the leaves the
+    genome path reads, and a genome field add/rename shows up as an audit
+    diff instead of silent unpriced traffic. Each leaf is `[S]` per cluster
+    (uint32 thresholds, int32 cadences/spans; 4 bytes either way)."""
+    from raft_sim_tpu.scenario.genome import ScenarioGenome, leaf_dtype
+
+    return [
+        (f, jnp.dtype(leaf_dtype(f)).name) for f in ScenarioGenome._fields
+    ]
 
 
 def carry_leaf_names() -> list[str]:
@@ -225,7 +246,7 @@ def expected_checkpoint_keys() -> set[str]:
     derived the same way save() derives it, so a serializer change that
     drops or renames a key diverges from this and the round-trip check
     (rule `checkpoint-serialization`) names it."""
-    keys = {"__version__", "seed", "config_json", "keys"}
+    keys = {"__version__", "seed", "config_json", "scenario_json", "keys"}
     keys |= {f"state_{f}" for f in ClusterState._fields if f != "mailbox"}
     keys |= {f"mb_{f}" for f in Mailbox._fields}
     keys |= {f"metrics_{f}" for f in RunMetrics._fields}

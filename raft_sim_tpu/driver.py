@@ -311,8 +311,17 @@ class Session:
         """Resume exactly: state, keys, accumulated metrics, AND the original seed come
         back, so summary() after more run() calls matches a never-interrupted session
         and reset() rebuilds the same experiment. `devices` reshards on load (a
-        checkpoint is device-layout agnostic)."""
-        cfg, state, keys, metrics, seed = checkpoint.load(path)
+        checkpoint is device-layout agnostic). Scenario checkpoints (driver
+        `scenario run --save`) are rejected: a Session has no genome path, so
+        continuing one here would silently run a DIFFERENT experiment."""
+        cfg, state, keys, metrics, seed, scenario = checkpoint.load(path)
+        if scenario is not None:
+            raise ValueError(
+                f"checkpoint {path!r} carries scenario "
+                f"{scenario.get('name', '?')!r}: resume it with "
+                "`python -m raft_sim_tpu scenario run --resume`, not a plain "
+                "Session"
+            )
         self = cls.__new__(cls)
         self.apply_writer = None
         self.telemetry = None
@@ -379,6 +388,169 @@ def build_config(args) -> tuple[RaftConfig, int]:
     return (dataclasses.replace(cfg, **overrides) if overrides else cfg), batch
 
 
+def _nondefault_config(cfg: RaftConfig) -> dict:
+    """cfg's non-default fields (the portable config encoding repro artifacts
+    and hit files carry; RaftConfig(**this) rebuilds it)."""
+    return {
+        f.name: getattr(cfg, f.name)
+        for f in dataclasses.fields(RaftConfig)
+        if getattr(cfg, f.name) != f.default
+    }
+
+
+def _scenario_run(args, ap) -> int:
+    """`scenario run`: a fleet under a declarative nemesis program
+    (docs/SCENARIOS.md). One compiled program drives the whole phased
+    timeline; checkpoints carry the scenario (format v20) so resume cannot
+    silently continue a different experiment."""
+    from raft_sim_tpu.parallel import summarize
+    from raft_sim_tpu.scenario import genome as genome_mod
+    from raft_sim_tpu.scenario import program as program_mod
+
+    if args.resume:
+        conflicting = [
+            f.name for f in dataclasses.fields(RaftConfig)
+            if getattr(args, f.name) is not None
+        ]
+        for flag in ("preset", "scenario", "batch", "seed"):
+            if getattr(args, flag) is not None:
+                conflicting.append(flag)
+        if conflicting:
+            ap.error(
+                f"--resume is exclusive with config/scenario flags: "
+                f"{', '.join(conflicting)}"
+            )
+        cfg, state, keys, metrics, seed, scen = checkpoint.load(args.resume)
+        if scen is None:
+            ap.error(
+                f"{args.resume!r} is a plain checkpoint (no scenario); resume "
+                "it with `run --resume`"
+            )
+        prog = program_mod.from_dict(scen, cfg)
+        batch = state.role.shape[0]
+    else:
+        if not args.scenario:
+            ap.error("scenario run needs --scenario FILE (or --resume)")
+        cfg, batch = build_config(args)
+        try:
+            prog = program_mod.load(args.scenario, cfg)
+        except ValueError as ex:
+            ap.error(f"--scenario {args.scenario}: {ex}")
+        seed = args.seed if args.seed is not None else 0
+        root = jax.random.key(seed)
+        k_init, k_run = jax.random.split(root)
+        state = init_batch(cfg, k_init, batch)
+        keys = jax.random.split(k_run, batch)
+        metrics = scan.init_metrics_batch(batch)
+
+    g = genome_mod.broadcast(prog.genome, batch)
+
+    def cb(done, _state, m):
+        if args.progress:
+            v = int(np.sum(np.asarray(m.violations)))
+            print(f"  {done}/{args.ticks} ticks, violations={v}", file=sys.stderr)
+        return False
+
+    t0 = time.perf_counter()
+    state, m = chunked.run_chunked(
+        cfg, state, keys, args.ticks, chunk=args.chunk, callback=cb,
+        genome=g, seg_len=prog.seg_len,
+    )
+    metrics = chunked.merge_metrics(metrics, m)
+    out = summarize(metrics)._asdict()
+    dt = time.perf_counter() - t0
+    out["scenario"] = prog.name
+    out["segments"] = prog.n_segments
+    out["seg_len"] = prog.seg_len
+    out["wall_s"] = round(dt, 3)
+    out["cluster_ticks_per_s"] = round(batch * args.ticks / dt, 1)
+    print(json.dumps(out))
+    if args.save:
+        # exact=True rides the integer genome leaves along: a resumed run
+        # must draw from the IDENTICAL thresholds, not a 9-decimal rounding
+        # of them (checkpoint.py v20 contract).
+        checkpoint.save(
+            args.save, cfg, state, keys, metrics, seed=seed,
+            scenario=program_mod.to_dict(prog, exact=True),
+        )
+    return 0
+
+
+def _scenario_search(args, ap) -> int:
+    """`scenario search`: the cross-entropy violation hunt (scenario/search.py).
+    Prints the full result JSON; --out writes a replayable hit file for
+    `scenario shrink` when a violating genome was found."""
+    from raft_sim_tpu.scenario import search as search_mod
+
+    cfg, _ = build_config(args)
+    mutant = args.mutant
+    if mutant:
+        from raft_sim_tpu.scenario.mutation import mutant_config
+
+        try:
+            cfg = mutant_config(mutant, cfg)
+        except ValueError as ex:
+            ap.error(str(ex))
+    spec = search_mod.SearchSpec(
+        generations=args.generations,
+        population=args.population,
+        ticks=args.ticks,
+        window=args.window,
+        elite_frac=args.elite_frac,
+        seed=args.seed if args.seed is not None else 0,
+    )
+    try:
+        res = search_mod.search(cfg, spec)
+    except ValueError as ex:
+        ap.error(str(ex))
+    doc = {
+        "found": res.hit is not None,
+        "hit": res.hit,
+        "generations": res.generations,
+        "spec": res.spec,
+        "mutant": mutant,
+    }
+    if res.hit is not None and args.out:
+        hit_doc = {"config": _nondefault_config(cfg), "mutant": mutant, **res.hit}
+        with open(args.out, "w") as f:
+            json.dump(hit_doc, f, indent=1)
+            f.write("\n")
+        doc["hit_file"] = args.out
+    print(json.dumps(doc))
+    return 0
+
+
+def _scenario_shrink(args, ap) -> int:
+    """`scenario shrink`: minimize a search hit file to a repro artifact that
+    `tools/repro.py --scenario` replays bit-exactly."""
+    from raft_sim_tpu.scenario import shrink as shrink_mod
+
+    with open(args.hit) as f:
+        hit = json.load(f)
+    cfg = RaftConfig(**hit.get("config", {}))
+    if hit.get("mutant"):
+        from raft_sim_tpu.scenario.mutation import mutant_config
+
+        cfg = mutant_config(hit["mutant"], cfg)
+    try:
+        art = shrink_mod.shrink(
+            cfg, hit, mutant=hit.get("mutant"),
+            halving_rounds=args.halving_rounds, context=args.context,
+        )
+    except ValueError as ex:
+        ap.error(str(ex))
+    shrink_mod.save_artifact(args.out, art)
+    print(json.dumps({
+        "artifact": args.out,
+        "tick": art["tick"],
+        "kinds": art["kinds"],
+        "removed": art["removed"],
+        "segments": art["segments"],
+        "repro_cmd": f"python tools/repro.py --scenario {args.out}",
+    }))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="raft_sim_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -430,7 +602,75 @@ def main(argv=None) -> int:
 
     sub.add_parser("presets", help="list the BASELINE config presets")
 
+    sc = sub.add_parser(
+        "scenario",
+        help="adversarial scenario engine: phased nemesis runs, the "
+             "violation-hunting search, and hit shrinking (docs/SCENARIOS.md)",
+    )
+    ssub = sc.add_subparsers(dest="scmd", required=True)
+
+    srun = ssub.add_parser("run", help="run a fleet under a JSON nemesis program")
+    srun.add_argument("--scenario", metavar="FILE", default=None,
+                      help="declarative scenario file (scenario/program.py schema)")
+    srun.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    srun.add_argument("--batch", type=int, default=None)
+    srun.add_argument("--ticks", type=int, default=1000)
+    srun.add_argument("--seed", type=int, default=None)
+    srun.add_argument("--chunk", type=int, default=4096)
+    srun.add_argument("--backend", default="auto", metavar="NAME")
+    srun.add_argument("--progress", action="store_true")
+    srun.add_argument("--save", metavar="PATH",
+                      help="checkpoint at the end (records the scenario; "
+                           "format v20)")
+    srun.add_argument("--resume", metavar="PATH",
+                      help="resume a scenario checkpoint (restores the genome "
+                           "path; plain checkpoints are rejected)")
+    _add_config_flags(srun)
+
+    ssearch = ssub.add_parser(
+        "search", help="cross-entropy hunt for violating fault genomes"
+    )
+    ssearch.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    # build_config reads args.batch; the search population IS the batch.
+    ssearch.add_argument("--batch", type=int, default=None, help=argparse.SUPPRESS)
+    ssearch.add_argument("--mutant", default=None, metavar="NAME",
+                         help="TEST-ONLY: hunt a deliberately weakened kernel "
+                              "(scenario/mutation.py registry, e.g. "
+                              "'weak-quorum') to prove the hunt hunts")
+    ssearch.add_argument("--generations", type=int, default=8)
+    ssearch.add_argument("--population", type=int, default=64,
+                         help="genomes per generation = fleet batch size")
+    ssearch.add_argument("--ticks", type=int, default=512)
+    ssearch.add_argument("--window", type=int, default=64,
+                         help="telemetry window (fitness resolution)")
+    ssearch.add_argument("--elite-frac", type=float, default=0.25)
+    ssearch.add_argument("--seed", type=int, default=None)
+    ssearch.add_argument("--backend", default="auto", metavar="NAME")
+    ssearch.add_argument("--out", metavar="FILE", default=None,
+                         help="write the first violating hit (replayable; "
+                              "feeds `scenario shrink --hit`)")
+    _add_config_flags(ssearch)
+
+    sshrink = ssub.add_parser(
+        "shrink", help="minimize a search hit to a repro artifact"
+    )
+    sshrink.add_argument("--hit", metavar="FILE", required=True,
+                         help="hit file from `scenario search --out`")
+    sshrink.add_argument("--out", metavar="FILE", required=True,
+                         help="repro artifact path (tools/repro.py --scenario)")
+    sshrink.add_argument("--halving-rounds", type=int, default=3)
+    sshrink.add_argument("--context", type=int, default=30)
+    sshrink.add_argument("--backend", default="auto", metavar="NAME")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "scenario":
+        select_backend(args.backend)
+        return {
+            "run": _scenario_run,
+            "search": _scenario_search,
+            "shrink": _scenario_shrink,
+        }[args.scmd](args, ap)
 
     if args.cmd == "presets":
         for name, (cfg, batch) in sorted(PRESETS.items()):

@@ -179,6 +179,11 @@ class FleetSummary(NamedTuple):
     # log-matching check could not compare.
     noop_blocked: int
     lm_skipped_pairs: int
+    # Split-brain exposure (RunMetrics.multi_leader): fleet-total ticks with
+    # >= 2 concurrent LEADER roles. Legal under partitions (a deposed leader
+    # that has not heard the news); the graded precursor the scenario search
+    # climbs toward election-safety violations (docs/SCENARIOS.md).
+    multi_leader: int
 
 
 def gather_metrics(metrics):
@@ -274,5 +279,6 @@ def summarize(metrics) -> FleetSummary:
         total_cmds=int(np.sum(m.total_cmds, dtype=np.int64)),
         noop_blocked=int(np.sum(m.noop_blocked, dtype=np.int64)),
         lm_skipped_pairs=int(np.sum(m.lm_skipped_pairs, dtype=np.int64)),
+        multi_leader=int(np.sum(m.multi_leader, dtype=np.int64)),
         **_latency_rollup(m),
     )

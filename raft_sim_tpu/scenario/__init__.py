@@ -1,0 +1,35 @@
+"""Adversarial scenario engine: per-cluster fault genomes, phased nemesis
+programs, and a violation-hunting search + shrink loop.
+
+The fifth subsystem (alongside models/sim/parallel/analysis). The simulator's
+fault knobs stop being Python floats baked into one compiled program per
+point in fault space and become DATA:
+
+  genome.py   ScenarioGenome -- a pytree of per-cluster, per-segment fault
+              parameters (uint32 threshold-compare encoding), threaded
+              through sim/faults.make_inputs so one compiled program
+              evaluates a heterogeneous fleet: 100k different fault settings
+              per step instead of one per ~15-40s compile.
+  program.py  Phased nemesis timelines: S segments with per-segment genomes
+              compiled to dense [S] tables indexed by now // seg_len on
+              device, loadable from a declarative JSON scenario file
+              ("partition 200 ticks -> heal -> crash churn").
+  search.py   A host-side cross-entropy loop over genome populations: the
+              fleet IS the population, fitness comes from the telemetry
+              window counters (PR 2), and each generation is ONE device
+              call. Every evaluation is replayable from (genome, seed).
+  shrink.py   Minimizes a violating (genome, seed, horizon) triple to a
+              small repro artifact that tools/repro.py --scenario replays
+              bit-exactly and the flight recorder renders.
+  mutation.py TEST-ONLY deliberately-weakened kernel variants (quorum
+              off-by-one) proving the hunt actually hunts.
+
+Layering: scenario/ sits ABOVE sim/ (it imports faults/scan/telemetry; sim/
+duck-types the genome and never imports back). docs/SCENARIOS.md is the
+user-facing guide.
+"""
+
+from raft_sim_tpu.scenario.genome import ScenarioGenome
+from raft_sim_tpu.scenario.program import ScenarioProgram
+
+__all__ = ["ScenarioGenome", "ScenarioProgram"]

@@ -1,0 +1,44 @@
+"""TEST-ONLY weakened kernel variants: the search loop's ground truth.
+
+A violation hunter that never finds anything proves nothing -- maybe the
+kernel is safe, maybe the hunt is blind. These config subclasses weaken the
+kernel behind an explicit opt-in (driver `scenario search --mutant`, CI's
+scenario smoke job, tests/test_scenario.py) so the search demo has a target
+it MUST hit within a bounded generation budget: if the hunt cannot drive a
+quorum-off-by-one kernel to an election-safety violation, the hunt is
+broken, not the kernel. Never instantiate these outside tests/demos; the
+class is deliberately NOT reachable from RaftConfig flags or scenario files.
+
+The weakening rides the config (cfg.quorum feeds both kernels' vote counts
+and commit rule), so no second kernel source exists to drift: the mutant
+compiles the same step code at a different quorum literal -- one extra jit
+compile, zero extra lowered program structures (literal-blind hashes equal;
+analysis/jaxpr_audit.py structural_hash).
+"""
+
+from __future__ import annotations
+
+from raft_sim_tpu.utils.config import RaftConfig
+
+
+class WeakQuorumConfig(RaftConfig):
+    """quorum - 1: floor(N/2) instead of floor(N/2)+1, so two split-vote
+    candidates can both 'win' a term -- the reference's even-N majority bug
+    (SURVEY.md quorum note) made unconditional. Election safety violates
+    within a few elections once message drop forces vote splits."""
+
+    @property
+    def quorum(self) -> int:  # type: ignore[override]
+        return self.n_nodes // 2
+
+
+MUTANTS = {"weak-quorum": WeakQuorumConfig}
+
+
+def mutant_config(name: str, cfg: RaftConfig) -> RaftConfig:
+    """Rebuild `cfg` under the named mutant class (same field values)."""
+    import dataclasses
+
+    if name not in MUTANTS:
+        raise ValueError(f"unknown mutant {name!r} (have {sorted(MUTANTS)})")
+    return MUTANTS[name](**dataclasses.asdict(cfg))

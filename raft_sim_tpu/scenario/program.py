@@ -1,0 +1,143 @@
+"""Phased nemesis programs: declarative fault timelines over a fleet.
+
+A ScenarioProgram is S genome segments played in order, `seg_len` ticks each
+-- the Jepsen-nemesis shape ("partition for 200 ticks, heal, then crash the
+leaders") as pure data. On device the timeline is a dense `[S]` table per
+genome leaf indexed by `now // seg_len` (faults.genome_at): segments never
+fork compiles, never enter the scan carry, and the final segment holds past
+the program's end (so any horizon is legal). Programs load from a
+declarative JSON file:
+
+    {
+      "name": "partition-heal-crash",
+      "seg_len": 200,
+      "segments": [
+        {"partition_period": 32, "partition_prob": 1.0},
+        {},
+        {"crash_prob": 0.5, "crash_down_ticks": 12}
+      ]
+    }
+
+Segment keys are exactly `genome.segment`'s keywords (human units: float
+probabilities, tick cadences); an empty segment is fault-free. The same
+schema embedded under "scenario" is what checkpoints (v20) and repro
+artifacts carry, so every run is replayable from (scenario, seed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from raft_sim_tpu.scenario import genome as genome_mod
+from raft_sim_tpu.scenario.genome import ScenarioGenome
+from raft_sim_tpu.utils.config import RaftConfig
+
+# The declarative segment vocabulary (genome.segment keywords).
+SEGMENT_KEYS = frozenset({
+    "drop_prob", "partition_period", "partition_prob", "crash_prob",
+    "crash_down_ticks", "clock_skew_prob", "client_interval",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioProgram:
+    """A named phased timeline: `genome` holds `[S]` per-segment leaves,
+    `seg_len` is the static per-segment tick span."""
+
+    name: str
+    seg_len: int
+    genome: ScenarioGenome
+
+    @property
+    def n_segments(self) -> int:
+        return self.genome.drop.shape[0]
+
+    @property
+    def span(self) -> int:
+        """Ticks until the final segment becomes standing (it holds forever)."""
+        return self.seg_len * (self.n_segments - 1)
+
+
+def from_dict(doc: dict, cfg: RaftConfig | None = None) -> ScenarioProgram:
+    """Build (and validate) a program from the declarative schema above.
+    `cfg` enables the config-coupled checks (crash_period ceiling, the
+    client structural gate); pass it whenever the target config is known.
+
+    A `genome_raw` key (exact integer leaves, `genome.to_raw`; what
+    `to_dict(exact=True)` emits into checkpoints and artifacts) takes
+    precedence over re-encoding the human-unit segments: decode() rounds
+    probabilities, so rebuilding from segments alone could shift a uint32
+    threshold by an ulp and silently resume a *different* trajectory --
+    the exact failure the checkpoint-v20 scenario contract forbids."""
+    unknown = set(doc) - {"name", "seg_len", "segments", "genome_raw"}
+    if unknown:
+        raise ValueError(f"unknown scenario keys {sorted(unknown)}")
+    segments = doc.get("segments")
+    if not isinstance(segments, list) or not segments:
+        raise ValueError("scenario needs a non-empty 'segments' list")
+    seg_len = int(doc.get("seg_len", 1))
+    if seg_len < 1:
+        raise ValueError(f"seg_len must be >= 1, got {seg_len}")
+    for i, seg in enumerate(segments):
+        bad = set(seg) - SEGMENT_KEYS
+        if bad:
+            raise ValueError(
+                f"segment {i}: unknown keys {sorted(bad)} "
+                f"(legal: {sorted(SEGMENT_KEYS)})"
+            )
+    if doc.get("genome_raw") is not None:
+        g = genome_mod.from_raw(doc["genome_raw"])
+        if g.drop.shape[0] != len(segments):
+            raise ValueError(
+                f"genome_raw carries {g.drop.shape[0]} segments but the "
+                f"'segments' list has {len(segments)}"
+            )
+    else:
+        # crash_down_ticks defaults to 1 (minimal span) so fault-free
+        # segments validate under any crash_period.
+        g = genome_mod.from_segments([
+            genome_mod.segment(**{"crash_down_ticks": 1, **seg})
+            for seg in segments
+        ])
+    if cfg is not None:
+        genome_mod.validate(cfg, g)
+    return ScenarioProgram(
+        name=str(doc.get("name", "scenario")), seg_len=seg_len, genome=g
+    )
+
+
+def to_dict(program: ScenarioProgram, exact: bool = False) -> dict:
+    """Inverse of from_dict (decoded human units; round-trips the schema).
+    `exact=True` additionally embeds the integer genome leaves
+    (`genome_raw`) so the round trip is BIT-exact, not merely
+    9-decimal-exact -- required wherever the dict re-seeds a trajectory
+    (checkpoints, repro artifacts)."""
+    segs = []
+    for row in genome_mod.decode(program.genome):
+        seg = {
+            "drop_prob": row["drop_prob"],
+            "partition_period": row["partition_period"],
+            "partition_prob": row["partition_prob"],
+            "crash_prob": row["crash_prob"],
+            "crash_down_ticks": row["crash_down_ticks"],
+            "clock_skew_prob": row["clock_skew_prob"],
+            "client_interval": row["client_interval"],
+        }
+        segs.append({k: v for k, v in seg.items() if v not in (0, 0.0)} or {})
+    doc = {"name": program.name, "seg_len": program.seg_len, "segments": segs}
+    if exact:
+        doc["genome_raw"] = genome_mod.to_raw(program.genome)
+    return doc
+
+
+def load(path: str, cfg: RaftConfig | None = None) -> ScenarioProgram:
+    with open(path) as f:
+        return from_dict(json.load(f), cfg)
+
+
+def save(path: str, program: ScenarioProgram) -> str:
+    with open(path, "w") as f:
+        json.dump(to_dict(program), f, indent=1)
+        f.write("\n")
+    return path

@@ -41,13 +41,15 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
         lat_excluded=a.lat_excluded + b.lat_excluded,
         noop_blocked=a.noop_blocked + b.noop_blocked,
         lm_skipped_pairs=a.lm_skipped_pairs + b.lm_skipped_pairs,
+        multi_leader=a.multi_leader + b.multi_leader,
         ticks=a.ticks + b.ticks,
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
-def _chunk(cfg: RaftConfig, state: ClusterState, keys: jax.Array, n: int):
-    return scan.run_batch_minor(cfg, state, keys, n)
+@functools.partial(jax.jit, static_argnums=(0, 3, 5))
+def _chunk(cfg: RaftConfig, state: ClusterState, keys: jax.Array, n: int,
+           genome=None, seg_len: int = 1):
+    return scan.run_batch_minor(cfg, state, keys, n, genome=genome, seg_len=seg_len)
 
 
 def run_chunked(
@@ -57,19 +59,23 @@ def run_chunked(
     n_ticks: int,
     chunk: int = 1024,
     callback: Callable[[int, ClusterState, scan.RunMetrics], bool] | None = None,
+    genome=None,
+    seg_len: int = 1,
 ):
     """Scan a batched state forward `n_ticks` in jitted chunks.
 
     `callback(ticks_done, state, merged_metrics)` runs between chunks; returning True
     stops early (e.g. on a violation during invariant fuzzing). Returns
-    (final_state, merged_metrics).
+    (final_state, merged_metrics). `genome`/`seg_len` select the scenario
+    input path (scan.run_batch_minor); segment boundaries are driven by the
+    absolute tick in state.now, so chunking never shifts a nemesis phase.
     """
     batch = state.role.shape[0]
     metrics = scan.init_metrics_batch(batch)
     done = 0
     while done < n_ticks:
         n = min(chunk, n_ticks - done)
-        state, m = _chunk(cfg, state, keys, n)
+        state, m = _chunk(cfg, state, keys, n, genome, seg_len)
         metrics = merge_metrics(metrics, m)
         done += n
         if callback is not None and callback(done, state, metrics):

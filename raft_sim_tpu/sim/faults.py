@@ -24,6 +24,20 @@ inputs derived deterministically from (cluster key, tick):
 Everything is a function of (key, now), so trajectories are replayable from a seed and
 checkpoint/resume needs only (state, key) -- no RNG state in the carry.
 
+Every Bernoulli event is drawn as a uint32 THRESHOLD COMPARE (`p_to_u32` /
+`bern_u32`): `random_bits_u32 < threshold` instead of `uniform_float < p`. Two
+reasons. (1) The whole per-tick input pipeline stays integer-only, so the
+full compiled scan program -- not just the step kernels -- is float-free and
+the analyzer's float-op rule extends to it. (2) The threshold is DATA, not a
+baked Python float: the scenario engine (raft_sim_tpu/scenario) threads a
+per-cluster `ScenarioGenome` of traced `[S]`-segment fault parameters through
+`make_inputs`, and because the scalar-config path and the genome path draw
+through the SAME helpers from the SAME key streams, a genome that replicates
+the config scalars reproduces the scalar path's trajectories BIT-FOR-BIT
+(tests/test_scenario.py pins this). The genome is duck-typed here (fields
+`drop/part_period/part/crash/crash_down/skew/client_interval`, each a `[S]`
+per-segment leaf -- see scenario/genome.py); sim/ never imports scenario/.
+
 The per-cluster key is split once into disjoint streams (per-tick draws, per-cluster
 drop rate, per-window partition layout) so no fold_in value can collide across
 purposes.
@@ -39,12 +53,53 @@ from raft_sim_tpu.types import NIL, StepInputs
 from raft_sim_tpu.utils.config import RaftConfig
 from raft_sim_tpu.utils.rng import draw_timeouts
 
+# Threshold encoding of p = 0.5 (the partition group split): exactly half the
+# uint32 space.
+HALF_U32 = 1 << 31
+
+
+def p_to_u32(p: float) -> int:
+    """Probability -> uint32 Bernoulli threshold: an event fires iff a fresh
+    uint32 draw is < the threshold, so P(fire) = threshold / 2^32. p = 1.0
+    clamps to 2^32 - 1 (fires with probability 1 - 2^-32); p = 0.0 encodes to
+    0 and can never fire. Host-side Python only -- the returned int enters the
+    traced program as a uint32 literal (scalar configs) or rides a genome leaf
+    (scenario engine)."""
+    return max(0, min((1 << 32) - 1, int(round(p * (1 << 32)))))
+
+
+def bern_u32(key: jax.Array, thresh, shape=()) -> jax.Array:
+    """Bernoulli(thresh / 2^32) as an integer threshold compare over fresh
+    uint32 bits. `thresh` is a uint32 scalar -- a literal on the scalar-config
+    path, traced genome data on the scenario path; both consume the identical
+    draw from `key`, which is what makes homogeneous-genome trajectories
+    bit-exact with the scalar path."""
+    return jax.random.bits(key, shape, jnp.uint32) < thresh
+
 
 def crash_key(key: jax.Array) -> jax.Array:
     """The dedicated crash-schedule stream for a cluster key. fold_in(-1) is disjoint
     from the per-window fold_in(k_part, window >= 0) draws sharing this base."""
     _, _, k_part = jax.random.split(key, 3)
     return jax.random.fold_in(k_part, jnp.int32(-1))
+
+
+def _alive_at_t(cfg: RaftConfig, ckey, now, crash_t, crash_down):
+    """The ungated windowed-renewal body shared by the scalar path (alive_at)
+    and the genome path (make_inputs): `crash_t` is the uint32 crash
+    threshold, `crash_down` the max down-span -- literals on the scalar path,
+    traced per-cluster genome data on the scenario path; the window length
+    stays cfg.crash_period (static) either way."""
+    n = cfg.n_nodes
+    window = now // cfg.crash_period
+    off = now - window * cfg.crash_period
+    wkey = jax.random.fold_in(ckey, window)
+    k_sel, k_start, k_dur = jax.random.split(wkey, 3)
+    crashed = bern_u32(k_sel, crash_t, (n,))
+    start = jax.random.randint(k_start, (n,), 0, cfg.crash_period)
+    dur = jax.random.randint(k_dur, (n,), 1, crash_down + 1)
+    down = crashed & (off >= start) & (off < start + dur) & (now >= 0)
+    return ~down
 
 
 def alive_at(cfg: RaftConfig, ckey: jax.Array, now: jax.Array) -> jax.Array:
@@ -56,74 +111,45 @@ def alive_at(cfg: RaftConfig, ckey: jax.Array, now: jax.Array) -> jax.Array:
     so a node is never down across a window boundary) iff its per-window Bernoulli
     crash draw fired. `now < 0` reports alive (so tick 0 is never a "restart").
     """
-    n = cfg.n_nodes
     if cfg.crash_prob <= 0:
-        return jnp.ones((n,), bool)
-    window = now // cfg.crash_period
-    off = now - window * cfg.crash_period
-    wkey = jax.random.fold_in(ckey, window)
-    k_sel, k_start, k_dur = jax.random.split(wkey, 3)
-    crashed = jax.random.bernoulli(k_sel, cfg.crash_prob, (n,))
-    start = jax.random.randint(k_start, (n,), 0, cfg.crash_period)
-    dur = jax.random.randint(k_dur, (n,), 1, cfg.crash_down_ticks + 1)
-    down = crashed & (off >= start) & (off < start + dur) & (now >= 0)
-    return ~down
+        return jnp.ones((cfg.n_nodes,), bool)
+    return _alive_at_t(
+        cfg, ckey, now, jnp.uint32(p_to_u32(cfg.crash_prob)), cfg.crash_down_ticks
+    )
 
 
-def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
-    """Inputs for one cluster at tick `now`. `key` is the per-cluster base key."""
+def _partition_cut(
+    n: int, k_part: jax.Array, now: jax.Array, period, part_t
+) -> jax.Array:
+    """[N, N] bool: True on edges CUT by the rolling partition this tick.
+    Assignment is stable within each window of `period` ticks because it is
+    keyed by the window index, not the tick. `period` may be traced (genome
+    path; 0 disables via the `period > 0` gate, the `maximum` only guards the
+    division)."""
+    window = now // jnp.maximum(period, 1)
+    wkey = jax.random.fold_in(k_part, window)
+    k_group, k_active = jax.random.split(wkey)
+    group = bern_u32(k_group, jnp.uint32(HALF_U32), (n,))
+    active = bern_u32(k_active, part_t) & (period > 0)
+    same_side = group[:, None] == group[None, :]
+    return ~same_side & active
+
+
+def _skew_draw(n: int, k_skew: jax.Array, skew_t) -> jax.Array:
+    """[N] int32 local-clock increments: stall (+0) on the first half of the
+    threshold window, jump (+2) on the second, +1 otherwise."""
+    r = jax.random.bits(k_skew, (n,), jnp.uint32)
+    return jnp.where(r < (skew_t >> 1), 0, jnp.where(r < skew_t, 2, 1)).astype(
+        jnp.int32
+    )
+
+
+def _client_routing(cfg: RaftConfig, tkey: jax.Array):
+    """(client_target, client_bounce) draws -- the redirect-model routing
+    randomness (core.clj:154); zeros when the omniscient direct client is
+    active. Identical on the scalar and genome paths (routing model is a
+    STRUCTURAL config gate; genomes tune only the cadence)."""
     n = cfg.n_nodes
-    k_ticks, k_rate, k_part = jax.random.split(key, 3)
-    tkey = jax.random.fold_in(k_ticks, now)
-    k_drop, k_timeout, k_skew = jax.random.split(tkey, 3)
-
-    # Message drop (the reference's silently-dropped RPC, client.clj:38-40).
-    if cfg.drop_prob > 0:
-        if cfg.drop_prob_uniform:
-            p = jax.random.uniform(k_rate, (), maxval=cfg.drop_prob)
-        else:
-            p = cfg.drop_prob
-        deliver = ~jax.random.bernoulli(k_drop, p, (n, n))
-    else:
-        deliver = jnp.ones((n, n), bool)
-
-    # Rolling partitions: assignment is stable within each window of
-    # `partition_period` ticks because it is keyed by the window index, not the tick.
-    if cfg.partition_period > 0:
-        window = now // cfg.partition_period
-        wkey = jax.random.fold_in(k_part, window)
-        k_group, k_active = jax.random.split(wkey)
-        group = jax.random.bernoulli(k_group, 0.5, (n,))
-        active = jax.random.bernoulli(k_active, cfg.partition_prob)
-        same_side = group[:, None] == group[None, :]
-        deliver = deliver & (same_side | ~active)
-
-    # Clock skew.
-    if cfg.clock_skew_prob > 0:
-        u = jax.random.uniform(k_skew, (n,))
-        skew = jnp.where(
-            u < cfg.clock_skew_prob / 2,
-            0,
-            jnp.where(u < cfg.clock_skew_prob, 2, 1),
-        ).astype(jnp.int32)
-    else:
-        skew = jnp.ones((n,), jnp.int32)
-
-    # Election-timeout draws (one per node per tick, used on any timer reset).
-    timeout_draw = draw_timeouts(cfg, k_timeout, n)
-
-    # Client commands: value = tick at injection + 1 (payload bytes carry no
-    # protocol meaning in the reference either, log.clj:66-67; the +1 keeps 0 free
-    # and lets the commit-latency metric recover the offer tick from the value).
-    if cfg.client_interval > 0:
-        client_cmd = jnp.where(now % cfg.client_interval == 0, now + 1, NIL)
-    else:
-        client_cmd = jnp.int32(NIL)
-    client_cmd = jnp.asarray(client_cmd, jnp.int32)
-
-    # Client routing draws (redirect model only): the random node a fresh offer
-    # POSTs to, and the random peer each pipeline slot's leaderless redirect
-    # bounces to.
     if cfg.client_redirect:
         k_tgt, k_bnc = jax.random.split(jax.random.fold_in(tkey, 3))
         client_target = jax.random.randint(k_tgt, (), 0, n)
@@ -131,15 +157,121 @@ def make_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array) -> StepInputs:
     else:
         client_target = jnp.int32(0)
         client_bounce = jnp.zeros((cfg.client_pipeline,), jnp.int32)
+    return client_target, client_bounce
 
-    # Crash/restart schedule (restart edge = alive now, down last tick).
-    if cfg.crash_prob > 0:
+
+def genome_at(genome, now: jax.Array, seg_len: int):
+    """Resolve a `[S]`-segment genome to the segment active at tick `now`:
+    dense-table read `leaves[min(now // seg_len, S - 1)]` on device (the
+    phased-nemesis timeline of scenario/program.py). The final segment holds
+    past the program's end; S = 1 short-circuits to a static index so plain
+    (unphased) genomes pay no gather."""
+    s_count = genome.drop.shape[0]
+    if s_count == 1:
+        return jax.tree.map(lambda t: t[0], genome)
+    seg = jnp.minimum(now // seg_len, s_count - 1)
+    return jax.tree.map(lambda t: t[seg], genome)
+
+
+def make_inputs(
+    cfg: RaftConfig,
+    key: jax.Array,
+    now: jax.Array,
+    genome=None,
+    seg_len: int = 1,
+) -> StepInputs:
+    """Inputs for one cluster at tick `now`. `key` is the per-cluster base key.
+
+    `genome=None` (the default) is the scalar-config path: fault parameters
+    come from cfg, statically gated, exactly one mechanism set per compiled
+    program. A `genome` (duck-typed ScenarioGenome, `[S]` per-segment leaves;
+    `seg_len` static) switches to the scenario path: every mechanism is traced
+    unconditionally from the genome's threshold-encoded parameters, so ONE
+    compiled program evaluates a heterogeneous fleet -- per-cluster fault
+    settings are data, not compile points. Both paths share the same draw
+    helpers and key streams: a homogeneous genome built from cfg's scalars
+    (scenario.genome.from_config) reproduces this function's scalar-path
+    output bit-for-bit.
+    """
+    n = cfg.n_nodes
+    k_ticks, k_rate, k_part = jax.random.split(key, 3)
+    tkey = jax.random.fold_in(k_ticks, now)
+    k_drop, k_timeout, k_skew = jax.random.split(tkey, 3)
+
+    # Election-timeout draws (one per node per tick, used on any timer reset).
+    timeout_draw = draw_timeouts(cfg, k_timeout, n)
+    client_target, client_bounce = _client_routing(cfg, tkey)
+
+    if genome is not None:
+        g = genome_at(genome, now, seg_len)
+        deliver = ~bern_u32(k_drop, g.drop, (n, n))
+        deliver = deliver & ~_partition_cut(n, k_part, now, g.part_period, g.part)
+        skew = _skew_draw(n, k_skew, g.skew)
+        # Traced cadence: the `maximum` only guards the modulo; interval 0
+        # disables via the `> 0` gate (same values as the scalar branch).
+        ci = g.client_interval
+        client_cmd = jnp.asarray(
+            jnp.where((ci > 0) & (now % jnp.maximum(ci, 1) == 0), now + 1, NIL),
+            jnp.int32,
+        )
         ckey = crash_key(key)
-        alive = alive_at(cfg, ckey, now)
-        restarted = alive & ~alive_at(cfg, ckey, now - 1)
+        alive = _alive_at_t(cfg, ckey, now, g.crash, g.crash_down)
+        # Restart edge = alive now, down last tick. Both liveness reads use
+        # the segment active at `now`: across a segment boundary the edge is
+        # evaluated under the NEW segment's crash parameters (deterministic
+        # and replayable; documented in docs/SCENARIOS.md).
+        restarted = alive & ~_alive_at_t(cfg, ckey, now - 1, g.crash, g.crash_down)
     else:
-        alive = jnp.ones((n,), bool)
-        restarted = jnp.zeros((n,), bool)
+        # Message drop (the reference's silently-dropped RPC, client.clj:38-40).
+        if cfg.drop_prob > 0:
+            if cfg.drop_prob_uniform:
+                # Per-cluster rate uniform over [0, drop_prob] (BASELINE
+                # config 4), drawn directly in threshold space: a uint32
+                # threshold uniform over [0, p_to_u32(drop_prob)]. The +1
+                # modulus is clamped below 2^32 so p = 1.0 cannot wrap to a
+                # zero modulus; the modulo bias is < 2^-31 relative.
+                base = min(p_to_u32(cfg.drop_prob), (1 << 32) - 2)
+                p_t = jax.random.bits(k_rate, (), jnp.uint32) % jnp.uint32(base + 1)
+            else:
+                p_t = jnp.uint32(p_to_u32(cfg.drop_prob))
+            deliver = ~bern_u32(k_drop, p_t, (n, n))
+        else:
+            deliver = jnp.ones((n, n), bool)
+
+        # Rolling partitions (window-stable assignment, see _partition_cut).
+        if cfg.partition_period > 0:
+            deliver = deliver & ~_partition_cut(
+                n,
+                k_part,
+                now,
+                cfg.partition_period,
+                jnp.uint32(p_to_u32(cfg.partition_prob)),
+            )
+
+        # Clock skew.
+        if cfg.clock_skew_prob > 0:
+            skew = _skew_draw(n, k_skew, jnp.uint32(p_to_u32(cfg.clock_skew_prob)))
+        else:
+            skew = jnp.ones((n,), jnp.int32)
+
+        # Client commands: value = tick at injection + 1 (payload bytes carry
+        # no protocol meaning in the reference either, log.clj:66-67; the +1
+        # keeps 0 free and lets the commit-latency metric recover the offer
+        # tick from the value).
+        if cfg.client_interval > 0:
+            client_cmd = jnp.where(now % cfg.client_interval == 0, now + 1, NIL)
+        else:
+            client_cmd = jnp.int32(NIL)
+        client_cmd = jnp.asarray(client_cmd, jnp.int32)
+
+        # Crash/restart schedule (restart edge = alive now, down last tick).
+        if cfg.crash_prob > 0:
+            ckey = crash_key(key)
+            alive = alive_at(cfg, ckey, now)
+            restarted = alive & ~alive_at(cfg, ckey, now - 1)
+        else:
+            alive = jnp.ones((n,), bool)
+            restarted = jnp.zeros((n,), bool)
 
     return StepInputs(
         # Shipped bit-packed over the source axis (StepInputs docstring): the

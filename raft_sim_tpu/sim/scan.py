@@ -67,6 +67,15 @@ class RunMetrics(NamedTuple):
     # Liveness/coverage counters (StepInfo.noop_blocked / lm_skipped_pairs).
     noop_blocked: jax.Array  # int32: election wins denied their no-op slot
     lm_skipped_pairs: jax.Array  # int32: pair-checks skipped by ring log matching
+    # Split-brain exposure: ticks with >= 2 concurrent LEADER roles
+    # (StepInfo.n_leaders). LEGAL under partitions (a deposed leader has not
+    # heard the news yet) -- only SAME-term double leadership violates
+    # election safety -- but it is the graded precursor of that violation,
+    # which makes it both a useful observability counter and the scenario
+    # search's fitness signal toward election-safety breaks (a deceptive
+    # landscape otherwise: message drop maximizes leaderless churn while
+    # PREVENTING the concurrent successful elections a violation needs).
+    multi_leader: jax.Array  # int32: ticks with n_leaders >= 2
     ticks: jax.Array  # int32
 
 
@@ -94,6 +103,7 @@ def init_metrics() -> RunMetrics:
         lat_excluded=z,
         noop_blocked=z,
         lm_skipped_pairs=z,
+        multi_leader=z,
         ticks=z,
     )
 
@@ -120,6 +130,7 @@ def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
         lat_excluded=m.lat_excluded + info.lat_excluded,
         noop_blocked=m.noop_blocked + info.noop_blocked,
         lm_skipped_pairs=m.lm_skipped_pairs + info.lm_skipped_pairs,
+        multi_leader=m.multi_leader + (info.n_leaders >= 2),
         ticks=m.ticks + 1,
     )
 
@@ -131,14 +142,18 @@ def run(
     n_ticks: int,
     trace: bool = False,
     trace_states: bool = False,
+    genome=None,
+    seg_len: int = 1,
 ):
     """Scan one cluster forward `n_ticks`. Returns (final_state, metrics, outs) where
     `outs` is None, stacked StepInfo (trace=True), or (StepInfo, stacked states)
-    (trace_states=True)."""
+    (trace_states=True). `genome` (a ScenarioGenome with `[S]` leaves; `seg_len`
+    static) switches input generation to the scenario path (sim/faults.py) --
+    the step kernel itself is untouched."""
 
     def body(carry, _):
         s, m = carry
-        inp = faults.make_inputs(cfg, key, s.now)
+        inp = faults.make_inputs(cfg, key, s.now, genome=genome, seg_len=seg_len)
         s2, info = raft.step(cfg, s, inp)
         m2 = _accumulate(m, info, s.now)
         if trace_states:
@@ -159,9 +174,16 @@ def run_batch(
     keys: jax.Array,
     n_ticks: int,
     trace: bool = False,
+    genome=None,
+    seg_len: int = 1,
 ):
-    """vmap'd `run` over the leading batch axis of `state` / `keys`."""
-    return jax.vmap(lambda s, k: run(cfg, s, k, n_ticks, trace=trace))(state, keys)
+    """vmap'd `run` over the leading batch axis of `state` / `keys` (and, when
+    given, the `[B, S]` genome rows -- one private fault setting per cluster)."""
+    if genome is None:
+        return jax.vmap(lambda s, k: run(cfg, s, k, n_ticks, trace=trace))(state, keys)
+    return jax.vmap(
+        lambda s, k, g: run(cfg, s, k, n_ticks, trace=trace, genome=g, seg_len=seg_len)
+    )(state, keys, genome)
 
 
 def run_batch_minor(
@@ -170,6 +192,8 @@ def run_batch_minor(
     keys: jax.Array,
     n_ticks: int,
     step_fn=None,
+    genome=None,
+    seg_len: int = 1,
 ):
     """Batch-minor hot path: same trajectories as `run_batch` (bit-for-bit; see
     tests/test_batched_parity.py) via models/raft_batched.step_b, with the batch axis
@@ -178,7 +202,11 @@ def run_batch_minor(
     convention. No per-tick trace output (use run_batch for tracing).
 
     `step_fn(cfg, state_minor, inputs_minor)` overrides the tick kernel (the Pallas
-    engine passes its kernelized step here so both engines share one scan body)."""
+    engine passes its kernelized step here so both engines share one scan body).
+    `genome` ([B, S] ScenarioGenome rows; `seg_len` static) switches input
+    generation to the scenario path -- a heterogeneous fleet through ONE
+    compiled program; the genome rides the scan as loop constants, never the
+    carry."""
     from raft_sim_tpu.models import raft_batched
 
     if step_fn is None:
@@ -188,7 +216,9 @@ def run_batch_minor(
 
     def body(carry, _):
         s, m = carry
-        s2, m2, _ = tick_batch_minor(cfg, s, keys, m, step_fn=step_fn)
+        s2, m2, _ = tick_batch_minor(
+            cfg, s, keys, m, step_fn=step_fn, genome=genome, seg_len=seg_len
+        )
         return (s2, m2), None
 
     # Metrics ride the scan batch-minor too (the histogram leaf is [BINS, B]
@@ -205,7 +235,9 @@ def run_batch_minor(
     )
 
 
-def tick_batch_minor(cfg, s, keys, metrics, step_fn=None, client_cmd=None):
+def tick_batch_minor(
+    cfg, s, keys, metrics, step_fn=None, client_cmd=None, genome=None, seg_len=1
+):
     """ONE tick of the batch-minor path: input generation, step, metric
     accumulation. `s` is batch-minor; `keys` keep their [B]-leading layout (input
     draws are vmapped batch-leading, then transposed). The single shared tick body
@@ -218,7 +250,16 @@ def tick_batch_minor(cfg, s, keys, metrics, step_fn=None, client_cmd=None):
 
     if step_fn is None:
         step_fn = raft_batched.step_b
-    inp = jax.vmap(lambda k, now: faults.make_inputs(cfg, k, now))(keys, s.now)
+    if genome is None:
+        inp = jax.vmap(lambda k, now: faults.make_inputs(cfg, k, now))(keys, s.now)
+    else:
+        # [B, S] genome rows vmap alongside the keys: cluster b's inputs come
+        # from ITS fault setting (sim/faults.py scenario path).
+        inp = jax.vmap(
+            lambda k, now, g: faults.make_inputs(
+                cfg, k, now, genome=g, seg_len=seg_len
+            )
+        )(keys, s.now, genome)
     if client_cmd is not None:
         inp = inp._replace(client_cmd=jnp.full_like(inp.client_cmd, client_cmd))
     inp_t = raft_batched.to_batch_minor(inp)
@@ -241,6 +282,24 @@ def simulate(cfg: RaftConfig, seed, batch: int, n_ticks: int):
     state = init_batch(cfg, k_init, batch)
     keys = jax.random.split(k_run, batch)
     return run_batch_minor(cfg, state, keys, n_ticks)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 5))
+def simulate_scenario(cfg: RaftConfig, seed, batch: int, n_ticks: int, genome,
+                      seg_len: int = 1):
+    """`simulate` through the scenario path: one compiled program evaluating a
+    heterogeneous fleet, cluster b under genome row b ([B, S] leaves, traced --
+    new genome VALUES never recompile; only a new S or seg_len does). Init and
+    key derivation are identical to `simulate`, so a homogeneous genome
+    (scenario.genome.from_config) reproduces `simulate(cfg, seed, ...)`
+    bit-for-bit and every (genome, seed) pair is replayable standalone."""
+    root = jax.random.key(seed)
+    k_init, k_run = jax.random.split(root)
+    from raft_sim_tpu.types import init_batch
+
+    state = init_batch(cfg, k_init, batch)
+    keys = jax.random.split(k_run, batch)
+    return run_batch_minor(cfg, state, keys, n_ticks, genome=genome, seg_len=seg_len)
 
 
 def stable_leader_ticks(metrics: RunMetrics) -> jax.Array:

@@ -145,10 +145,15 @@ def run_batch_minor_telemetry(
     window: int,
     recorder: FlightRecorder | None = None,
     step_fn=None,
+    genome=None,
+    seg_len: int = 1,
 ):
     """`scan.run_batch_minor` with telemetry carry legs: same trajectories
     (bit-for-bit -- the tick body is shared), plus [n_ticks/window]
     WindowRecords and an optional flight recorder threaded through.
+    `genome`/`seg_len` select the scenario input path (scan.tick_batch_minor):
+    window records over a heterogeneous fleet are the search loop's fitness
+    signal (scenario/search.py).
 
     `n_ticks` must divide by `window` (the chunked driver handles remainders
     by a final shorter call). `recorder` enters and leaves BATCH-MINOR (the
@@ -170,7 +175,9 @@ def run_batch_minor_telemetry(
     def inner(carry, _):
         s, wm, fv, rec = carry
         now = s.now  # [B] absolute tick BEFORE the step (lockstep across B)
-        s2, wm2, info = scan.tick_batch_minor(cfg, s, keys, wm, step_fn=step_fn)
+        s2, wm2, info = scan.tick_batch_minor(
+            cfg, s, keys, wm, step_fn=step_fn, genome=genome, seg_len=seg_len
+        )
         bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
         fv2 = jnp.minimum(fv, jnp.where(bad, now, NEVER))
         rec2 = _record(rec, info, now, ring_k) if ring_k else rec
@@ -199,14 +206,18 @@ def run_batch_minor_telemetry(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 5, 7))
 def simulate_windowed(
-    cfg: RaftConfig, seed, batch: int, n_ticks: int, window: int, ring: int = 0
+    cfg: RaftConfig, seed, batch: int, n_ticks: int, window: int, ring: int = 0,
+    genome=None, seg_len: int = 1,
 ):
     """`scan.simulate` with telemetry: one-call batched init + windowed scan.
     Returns (final_state, metrics, records, recorder) -- metrics/trajectories
     bit-identical to `scan.simulate` for the same (cfg, seed, batch, n_ticks).
-    `ring` > 0 enables the flight recorder at that depth."""
+    `ring` > 0 enables the flight recorder at that depth. `genome` ([B, S]
+    rows, traced) selects the scenario path: the search loop evaluates a whole
+    genome population in THIS one device call, and new genome values reuse the
+    compiled program (only a new S/seg_len recompiles)."""
     root = jax.random.key(seed)
     k_init, k_run = jax.random.split(root)
     from raft_sim_tpu.types import init_batch
@@ -214,13 +225,17 @@ def simulate_windowed(
     state = init_batch(cfg, k_init, batch)
     keys = jax.random.split(k_run, batch)
     rec = init_recorder(cfg, ring, batch) if ring else None
-    return run_batch_minor_telemetry(cfg, state, keys, n_ticks, window, rec)
+    return run_batch_minor_telemetry(
+        cfg, state, keys, n_ticks, window, rec, genome=genome, seg_len=seg_len
+    )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6))
-def _chunk_t(cfg, state, keys, rec, n, window, ring_k):
+@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6, 8))
+def _chunk_t(cfg, state, keys, rec, n, window, ring_k, genome=None, seg_len=1):
     recorder = rec if ring_k else None
-    return run_batch_minor_telemetry(cfg, state, keys, n, window, recorder)
+    return run_batch_minor_telemetry(
+        cfg, state, keys, n, window, recorder, genome=genome, seg_len=seg_len
+    )
 
 
 def run_chunked_telemetry(
@@ -232,6 +247,8 @@ def run_chunked_telemetry(
     recorder: FlightRecorder | None = None,
     chunk: int = 4096,
     callback=None,
+    genome=None,
+    seg_len: int = 1,
 ):
     """Long-horizon telemetry runs: the `chunked.run_chunked` analogue with
     window records offloaded to the host between chunks (so a 10M-tick soak
@@ -257,7 +274,7 @@ def run_chunked_telemetry(
         else:
             n = w = left  # remainder: one final short window
         state, m, recs, recorder = _chunk_t(
-            cfg, state, keys, recorder, n, w, ring_k
+            cfg, state, keys, recorder, n, w, ring_k, genome, seg_len
         )
         metrics = merge_metrics(metrics, m)
         done += n

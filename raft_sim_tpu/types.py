@@ -292,7 +292,13 @@ class ClusterState(NamedTuple):
 class StepInputs(NamedTuple):
     """Pure per-tick inputs. Randomness is *materialized outside* the step kernel so the
     same arrays can drive both the jnp kernel and the Python oracle (tests), and so fault
-    schedules are plain data (SURVEY.md section 5, failure injection)."""
+    schedules are plain data (SURVEY.md section 5, failure injection).
+
+    This boundary is what makes the scenario engine free: per-cluster fault
+    genomes and phased nemesis programs (raft_sim_tpu/scenario) change only
+    how sim/faults.make_inputs FILLS these arrays -- the step kernels consume
+    the identical structure either way, so the genome path adds zero step
+    lowerings and a homogeneous genome is bit-exact with the scalar path."""
 
     # Bit-packed delivery mask (ops/bitplane.py), packed over the SOURCE axis:
     # bit s of deliver_mask[d] clear = the message on physical edge [d, s]

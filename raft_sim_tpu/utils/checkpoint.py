@@ -63,7 +63,16 @@ from raft_sim_tpu.utils.config import RaftConfig
 # v19: metrics v3 -- RunMetrics gained lat_excluded (the latency coverage-gap
 #      counter: client entries first committed in leaderless windows, measured
 #      instead of documented-away). ClusterState is unchanged.
-_FORMAT_VERSION = 19
+# v20: scenario engine -- checkpoints gained the scenario_json key recording
+#      the active nemesis program (scenario/program.py schema; '{}' for plain
+#      runs). A scenario run's trajectory is a function of (config, genome,
+#      seed), so resuming one WITHOUT its scenario would silently continue a
+#      different experiment: plain resume rejects scenario checkpoints
+#      (driver `scenario run --resume` restores the genome path). Metrics v4:
+#      RunMetrics gained multi_leader (split-brain exposure ticks -- the
+#      search's election-safety precursor signal). ClusterState/Mailbox are
+#      unchanged.
+_FORMAT_VERSION = 20
 
 # The single exported source of truth for the on-disk format version
 # (re-exported as raft_sim_tpu.CHECKPOINT_FORMAT_VERSION). Everything that
@@ -79,7 +88,7 @@ FORMAT_VERSION = _FORMAT_VERSION
 # refreshing this pin -- the convention the v2..v19 log always relied on,
 # now machine-checked. Refresh with:
 #     python -c "from raft_sim_tpu.analysis import policy; print(policy.schema_fingerprint())"
-_SCHEMA_FINGERPRINT = (19, "958f6e7a244df547")
+_SCHEMA_FINGERPRINT = (20, "174ef133b42039cb")
 
 
 def _normalize(path: str) -> str:
@@ -94,9 +103,13 @@ def save(
     keys: jax.Array,
     metrics: RunMetrics,
     seed: int = 0,
+    scenario: dict | None = None,
 ) -> str:
     """Write (config, batched state, per-cluster run keys, accumulated metrics, seed).
-    Returns the actual path written (always .npz-suffixed)."""
+    Returns the actual path written (always .npz-suffixed). `scenario` is the
+    declarative nemesis program driving the run (scenario/program.py to_dict
+    schema) -- part of the trajectory's identity, so it rides the checkpoint;
+    None marks a plain scalar-config run."""
     path = _normalize(path)
     arrays = {f"state_{f}": np.asarray(v) for f, v in zip(state._fields, state) if f != "mailbox"}
     arrays |= {f"mb_{f}": np.asarray(v) for f, v in zip(state.mailbox._fields, state.mailbox)}
@@ -107,13 +120,18 @@ def save(
         __version__=np.int32(_FORMAT_VERSION),
         seed=np.int64(seed),
         config_json=np.bytes_(json.dumps(dataclasses.asdict(cfg)).encode()),
+        scenario_json=np.bytes_(json.dumps(scenario or {}).encode()),
         **arrays,
     )
     return path
 
 
-def load(path: str) -> tuple[RaftConfig, ClusterState, jax.Array, RunMetrics, int]:
-    """Read a checkpoint; returns (cfg, state, keys, metrics, seed) ready to resume."""
+def load(
+    path: str,
+) -> tuple[RaftConfig, ClusterState, jax.Array, RunMetrics, int, dict | None]:
+    """Read a checkpoint; returns (cfg, state, keys, metrics, seed, scenario)
+    ready to resume. `scenario` is None for plain runs, else the program dict
+    `save` recorded -- the caller must resume through the scenario path."""
     with np.load(_normalize(path)) as z:
         version = int(z["__version__"])
         if version != _FORMAT_VERSION:
@@ -141,4 +159,5 @@ def load(path: str) -> tuple[RaftConfig, ClusterState, jax.Array, RunMetrics, in
             **{f: jax.numpy.asarray(z[f"metrics_{f}"]) for f in RunMetrics._fields}
         )
         seed = int(z["seed"])
-    return cfg, state, keys, metrics, seed
+        scenario = json.loads(bytes(z["scenario_json"]).decode()) or None
+    return cfg, state, keys, metrics, seed, scenario

@@ -40,7 +40,9 @@ from raft_sim_tpu.utils.config import RaftConfig
 
 # Bump on any incompatible change to the manifest or line formats; validate()
 # refuses mismatched directories and metrics_report refuses to diff them.
-TELEMETRY_SCHEMA_VERSION = 1
+# v2: window lines gained multi_leader (split-brain exposure ticks --
+#     RunMetrics metrics v4, the scenario search's election-safety precursor).
+TELEMETRY_SCHEMA_VERSION = 2
 
 # A "never happened" tick sentinel (scan.NEVER) becomes JSON null.
 _NEVER = 2**31 - 1
@@ -62,6 +64,7 @@ WINDOW_FIELDS = (
     "lat_excluded",
     "noop_blocked",
     "lm_skipped_pairs",
+    "multi_leader",
 )
 
 MANIFEST_FIELDS = (
@@ -171,6 +174,9 @@ class TelemetrySink:
                 "noop_blocked": int(m["noop_blocked"].astype(np.int64)[:, w].sum()),
                 "lm_skipped_pairs": int(
                     m["lm_skipped_pairs"].astype(np.int64)[:, w].sum()
+                ),
+                "multi_leader": int(
+                    m["multi_leader"].astype(np.int64)[:, w].sum()
                 ),
                 "lat_hist": [
                     int(x) for x in m["lat_hist"].astype(np.int64)[:, w].sum(axis=0)

@@ -130,10 +130,16 @@ def test_invariant_leaves_match_lowered_scan():
 
 
 def test_recompile_fork_guard():
-    # pre_vote genuinely forks the program: the guard must see it ...
+    # pre_vote genuinely forks the program: the guard must see it on BOTH the
+    # plain scan and the scenario (genome-path) scan ...
     got = jaxpr_audit.check_recompile_forks((("config3", {"pre_vote": True}),))
-    assert [f.rule for f in got] == ["recompile-fork"]
-    # ... while a tuning-only change must not (one standing pair, cheap).
+    assert [f.rule for f in got] == ["recompile-fork", "recompile-fork"]
+    assert {f.path for f in got} == {
+        "jaxpr:config3/simulate", "jaxpr:config3/scenario_simulate"
+    }
+    # ... while a tuning-only change must not (one standing pair, cheap) --
+    # and on the scenario program that includes the fault knobs themselves:
+    # genomes exist so fault sweeps are data, never compiles.
     assert jaxpr_audit.check_recompile_forks(
         (("config2", {"client_interval": 12}),)
     ) == []
@@ -180,7 +186,10 @@ def test_dtype_comment_rule_fires_on_drift():
 def test_checkpoint_version_rule(monkeypatch):
     assert ast_lint.check_checkpoint_version() == []
     # Seeded negative: a field change that was not pinned (hash drifts).
-    monkeypatch.setattr(checkpoint, "_SCHEMA_FINGERPRINT", (19, "deadbeefdeadbeef"))
+    monkeypatch.setattr(
+        checkpoint, "_SCHEMA_FINGERPRINT",
+        (checkpoint._FORMAT_VERSION, "deadbeefdeadbeef"),
+    )
     got = ast_lint.check_checkpoint_version()
     assert [f.rule for f in got] == ["checkpoint-version"]
     assert "bump _FORMAT_VERSION" in got[0].message

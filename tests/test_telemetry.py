@@ -134,6 +134,11 @@ def test_windowed_records_match_full_per_tick_stack(fuzz_run):
             per_tick = np.asarray(getattr(stack, stack_f))[:, sl].sum(axis=1)
             windowed = np.asarray(getattr(recs.metrics, win_f))[:, wi]
             np.testing.assert_array_equal(per_tick, windowed, err_msg=stack_f)
+        # multi_leader folds the derived per-tick predicate (n_leaders >= 2).
+        np.testing.assert_array_equal(
+            (np.asarray(stack.n_leaders)[:, sl] >= 2).sum(axis=1),
+            np.asarray(recs.metrics.multi_leader)[:, wi],
+        )
         hist = np.asarray(stack.lat_hist)[:, sl].sum(axis=1)
         np.testing.assert_array_equal(hist, np.asarray(recs.metrics.lat_hist)[:, wi])
         np.testing.assert_array_equal(

@@ -40,7 +40,7 @@ def _merge_windows(rows: list[dict]) -> dict:
     hist = np.zeros(LAT_HIST_BINS, np.int64)
     tot = {k: 0 for k in ("violations", "msgs", "cmds", "lat_sum", "lat_cnt",
                           "lat_excluded", "noop_blocked", "lm_skipped_pairs",
-                          "ticks")}
+                          "multi_leader", "ticks")}
     first_viol = None
     mx = {"max_term": 0, "max_commit": 0}
     for r in rows:
@@ -155,7 +155,7 @@ def report(directory: str, n_windows: int, out=sys.stdout) -> None:
     print(f"\n  {len(rows)} windows, {totals['ticks']} ticks per cluster", file=out)
     keys = ("violations", "first_viol_tick", "msgs", "cmds", "max_commit",
             "mean_commit_latency", "lat_p50", "lat_p95", "lat_p99",
-            "lat_excluded", "noop_blocked", "lm_skipped_pairs")
+            "lat_excluded", "noop_blocked", "lm_skipped_pairs", "multi_leader")
     for k in keys:
         print(f"  {k:22} {_fmt(totals.get(k)):>14}", file=out)
 
@@ -207,7 +207,7 @@ def diff(path_a: str, path_b: str, config: str | None, out=sys.stdout) -> None:
         "violations", "cmds", "msgs", "max_commit", "p50_stable_tick",
         "cluster_ticks_per_s", "mean_commit_latency", "p50_commit_latency",
         "lat_p50", "lat_p95", "lat_p99", "lat_excluded", "noop_blocked",
-        "lm_skipped_pairs",
+        "lm_skipped_pairs", "multi_leader",
     ) if k in a or k in b]
     print(f"A: {label_a}\nB: {label_b}\n", file=out)
     print(f"{'metric':22} {'A':>14} {'B':>14} {'delta':>14}", file=out)

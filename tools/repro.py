@@ -16,10 +16,17 @@ per-tick tracing to find the exact first violating tick, and emits
 Usage:
     python tools/repro.py --preset config4 --seed 7 --ticks 20000 [--batch N]
     python tools/repro.py --n-nodes 5 --drop-prob 0.3 --seed 3 --ticks 5000
+    python tools/repro.py --scenario repro.json   # replay a shrunk artifact
 
 Exits 0 printing {"found": false} when the run is clean. Library entry:
 `shrink(cfg, seed, batch, n_ticks)` -- tests/test_repro.py demonstrates it
 against an artificially broken kernel (quorum - 1).
+
+`--scenario` replays a scenario-engine repro artifact
+(raft_sim_tpu/scenario/shrink.py, `scenario shrink --out`): it rebuilds the
+exact kernel (including TEST-ONLY mutants), reruns the minimized (genome,
+seed) at the trimmed horizon, and exits 0 iff the violation reproduces at
+the IDENTICAL tick with identical kinds -- the CI scenario smoke contract.
 """
 
 from __future__ import annotations
@@ -27,7 +34,10 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import numpy as np
@@ -119,18 +129,48 @@ def _repro_cmd(cfg: RaftConfig, seed: int, batch: int, tick: int) -> str:
     )
 
 
+def replay_scenario(path: str, context: int) -> int:
+    """Replay a scenario repro artifact; 0 = reproduced at the identical tick."""
+    from raft_sim_tpu.scenario import shrink as shrink_mod
+
+    art = shrink_mod.load_artifact(path)
+    res = shrink_mod.replay_artifact(art, context=context)
+    print(json.dumps({
+        "found": res["tick"] is not None,
+        "reproduced": res["reproduced"],
+        "tick": res["tick"],
+        "expected_tick": res["expected_tick"],
+        "kinds": res["kinds"],
+        "expected_kinds": res["expected_kinds"],
+        "mutant": art.get("mutant"),
+        "segments": art.get("segments"),
+    }))
+    for t, e in res["events"]:
+        marker = " <== VIOLATION TICK" if t == res["tick"] else ""
+        print(f"tick {t:>7}  {e}{marker}", file=sys.stderr)
+    return 0 if res["reproduced"] else 2
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="repro")
     ap.add_argument("--preset", choices=sorted(PRESETS), default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--batch", type=int, default=None)
-    ap.add_argument("--ticks", type=int, required=True)
+    ap.add_argument("--ticks", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=512)
     ap.add_argument("--context", type=int, default=30)
+    ap.add_argument("--scenario", metavar="FILE", default=None,
+                    help="replay a scenario repro artifact instead of "
+                         "shrinking a scalar-config run (exit 0 iff the "
+                         "violation reproduces at the identical tick)")
     from raft_sim_tpu.driver import _add_config_flags, build_config
 
     _add_config_flags(ap)
     args = ap.parse_args(argv)
+    if args.scenario:
+        return replay_scenario(args.scenario, args.context)
+    if args.ticks is None:
+        ap.error("--ticks is required (unless replaying with --scenario)")
     cfg, batch = build_config(args)
     if args.batch is not None:
         batch = args.batch

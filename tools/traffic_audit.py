@@ -160,6 +160,22 @@ def _telemetry_rows(cfg: RaftConfig, ring_k: int):
     return rows
 
 
+def _scenario_rows(s_count: int):
+    """(group, name, shape, dtype-size) rows for the scenario-engine genome:
+    7 `[S]` per-cluster leaves (uint32 thresholds / int32 cadences -- the set
+    single-sourced from analysis/policy.py:scenario_genome_leaves, which the
+    genome path actually reads). The genome rides the scan body as loop
+    CONSTANTS -- priced once per tick like the other inputs (the per-tick
+    segment gather touches one element per leaf; pricing the whole `[S]`
+    table is the conservative bound)."""
+    from raft_sim_tpu.analysis.policy import scenario_genome_leaves
+
+    return [
+        ("scenario", f"gen.{name}", (s_count,), 4)
+        for name, _dtype in scenario_genome_leaves()
+    ]
+
+
 def audit(cfg: RaftConfig, batch: int):
     """Both layouts' per-cluster-tick byte totals. Carry leaves move twice per
     tick (read + write); inputs once (materialized from the key stream)."""
@@ -201,7 +217,7 @@ def _fmt_bytes(b):
 
 
 def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
-           telemetry_ring: int | None = None):
+           telemetry_ring: int | None = None, scenario_segments: int | None = None):
     a = audit(cfg, batch)
     w = bitplane.n_words(cfg.n_nodes)
     print(f"\n== {name}: N={cfg.n_nodes} (W={w}), CAP={cfg.log_capacity}, "
@@ -286,6 +302,29 @@ def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
             "telemetry_window_only_padded": wm_pad,
             "telemetry_overhead_frac": tel_pad / pp,
         }
+    if scenario_segments is not None:
+        # Scenario-engine overhead: the genome broadcast (S-segment program
+        # table, 7 leaves x 4 B per cluster) read each tick by the genome
+        # input path. Inputs move ONCE per tick (like in.*); the carry is
+        # untouched (the genome is a scan const, never a carry leg), so this
+        # is the WHOLE per-cluster traffic cost of heterogeneous fault
+        # space -- docs/PERF.md "scenario path" records the standing verdict.
+        sc_rows = _scenario_rows(scenario_segments)
+        sc_log = sum(_logical(s, i) for _, _, s, i in sc_rows)
+        sc_pad = sum(_padded(s, i, batch) for _, _, s, i in sc_rows)
+        print(
+            f"scenario genome table (S={scenario_segments} segments, "
+            f"{len(sc_rows)} leaves): {_fmt_bytes(sc_log)} logical / "
+            f"{_fmt_bytes(sc_pad)} padded per cluster-tick = "
+            f"+{100 * sc_pad / pp:.2f}% over the packed tick",
+            file=out,
+        )
+        res |= {
+            "scenario_segments": scenario_segments,
+            "scenario_logical": sc_log,
+            "scenario_padded": sc_pad,
+            "scenario_overhead_frac": sc_pad / pp,
+        }
     return res
 
 
@@ -302,6 +341,11 @@ def main(argv=None) -> int:
                     help="also price the telemetry carry legs: the window "
                          "accumulator plus a K-deep flight-recorder ring "
                          "(K=0 prices windowed aggregation alone)")
+    ap.add_argument("--scenario", type=int, default=None, metavar="S",
+                    help="also price the scenario-engine genome broadcast: "
+                         "an S-segment program table per cluster "
+                         "(raft_sim_tpu/scenario; S=1 prices a plain "
+                         "heterogeneous-fleet genome)")
     args = ap.parse_args(argv)
 
     # With --json the human tables go to stderr so stdout is exactly one
@@ -316,7 +360,8 @@ def main(argv=None) -> int:
             return 2
         cfg, batch = PRESETS[name]
         results.append(report(name, cfg, batch, args.top, out=table_out,
-                              telemetry_ring=args.telemetry_ring))
+                              telemetry_ring=args.telemetry_ring,
+                              scenario_segments=args.scenario))
     if args.json:
         print(json.dumps(results))
     return 0
