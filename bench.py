@@ -75,6 +75,25 @@ SMOKE_BATCH = {
 SMOKE_TICKS = {"config1": 1_000, "config6": 1_000, "config6r": 1_000}
 
 
+def _roofline_pins() -> dict:
+    """Predicted per-config rooflines from the gated cost model's pins
+    (tests/golden_cost_model.json, regenerated via `tools/check.py
+    --update-goldens`): bytes/tick x the pinned implied HBM rate. Read-only
+    and fully guarded -- bench must still run where the pins are absent
+    (installed package, fresh clone); rows then simply omit the
+    predicted-vs-measured fields."""
+    try:
+        from raft_sim_tpu.analysis import cost_model
+
+        with open(cost_model.golden_path()) as f:
+            return json.load(f).get("programs", {})
+    except Exception:
+        return {}
+
+
+_ROOFLINE_PINS = _roofline_pins()
+
+
 def _telemetry_window(ticks: int) -> int:
     """A window size that divides the run (the windowed scan requires it):
     the finest of a few round divisors, falling back to one whole-run window."""
@@ -84,9 +103,21 @@ def _telemetry_window(ticks: int) -> int:
     return ticks
 
 
+def _pin_applies(config_name: str, batch: int, smoke: bool) -> bool:
+    """The pins are priced at the preset's production batch; a --smoke or
+    custom-batch row must not carry a headroom number computed against a
+    different-batch roofline (it would read as ~100x headroom on CPU).
+    `smoke` is checked on its own because a preset whose smoke batch equals
+    its production batch (config1: batch 1 both ways) would otherwise slip
+    through the batch comparison."""
+    return (not smoke and config_name in PRESETS
+            and batch == PRESETS[config_name][1])
+
+
 def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
           quality_seeds: int = 3, telemetry_dir: str | None = None,
-          config_name: str = "custom", scenario=None) -> dict:
+          config_name: str = "custom", scenario=None,
+          smoke: bool = False) -> dict:
     # `scenario` (a ScenarioProgram) reroutes every run through the
     # scenario-engine input path -- the program's genome broadcast over the
     # fleet -- so the row prices the genome-table reads and the
@@ -152,7 +183,18 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
         # row, not in the telemetry directory.
         sink.write_summary(summarize(pooled[0])._asdict())
     value = batch * ticks / best
-    return {
+    # Measured throughput vs the PINNED roofline (this program's bytes/tick x
+    # the pinned implied HBM rate -- equal to the anchor at pin time by
+    # construction, so this is a drift detector against the pins, not a
+    # layout-vs-layout bound; those live in tools/traffic_audit.py). ~1.0 =
+    # tracking the pins; >1 = slower than pinned (regression, or a non-HBM
+    # bottleneck at the pinned rate); <1 = faster than the pins -- they are
+    # stale, regenerate after this round's artifact lands.
+    pin = _ROOFLINE_PINS.get(f"{config_name}/simulate", {})
+    roof = pin.get("roofline_ticks_per_s")
+    if not _pin_applies(config_name, batch, smoke):
+        roof = None
+    row = {
         "cluster_ticks_per_s": round(value, 1),
         "vs_baseline": round(value / NORTH_STAR, 3),
         "batch": batch,
@@ -173,6 +215,14 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
         "multi_leader": s.multi_leader,
         "quality_seeds": quality_seeds,
     }
+    if smoke:
+        # Marked so cost_model.bench_anchor can reject the row even when the
+        # preset's smoke batch equals its production batch (config1).
+        row["smoke"] = True
+    if roof and scenario is None:
+        row["predicted_roofline_ticks_per_s"] = round(roof, 1)
+        row["roofline_headroom"] = round(roof / value, 3)
+    return row
 
 
 def main() -> None:
@@ -229,7 +279,7 @@ def main() -> None:
         print(f"bench {name}: batch={batch} ticks={ticks}...", file=sys.stderr)
         matrix[name] = bench(cfg, batch, ticks, args.repeats,
                              telemetry_dir=args.telemetry_dir, config_name=name,
-                             scenario=scenario)
+                             scenario=scenario, smoke=args.smoke)
         if scenario is not None:
             matrix[name]["scenario"] = scenario.name
 

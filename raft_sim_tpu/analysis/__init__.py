@@ -18,6 +18,12 @@ the full gate runs in well under two minutes on CPU:
                           float literals) plus the contract cross-checks
                           (types.py dtype comments, checkpoint fingerprint and
                           serialization round trip).
+  Pass C (`cost_model`)   prices the same lowered programs equation by
+                          equation -- scan-carry bytes/tick (per-leg, derived
+                          from the run loop's jaxpr), live-set peak, jit
+                          entry-point donation, roofline at the pinned HBM
+                          rate -- against tests/golden_cost_model.json, the
+                          roofline as a CI invariant instead of a hand table.
 
 Findings are schema'd JSON (`findings`, same idiom as the telemetry sink);
 intentional exceptions carry one-line justifications in
@@ -25,6 +31,8 @@ intentional exceptions carry one-line justifications in
 the tier-1 tests); rule catalogue and how-to-add-a-rule: docs/ANALYSIS.md.
 """
 
-from raft_sim_tpu.analysis import ast_lint, findings, jaxpr_audit, policy, run
+from raft_sim_tpu.analysis import (
+    ast_lint, cost_model, findings, jaxpr_audit, policy, run,
+)
 
-__all__ = ["ast_lint", "findings", "jaxpr_audit", "policy", "run"]
+__all__ = ["ast_lint", "cost_model", "findings", "jaxpr_audit", "policy", "run"]

@@ -28,6 +28,7 @@ from __future__ import annotations
 import ast
 import hashlib
 import inspect
+import math
 import re
 
 import jax
@@ -37,6 +38,35 @@ from raft_sim_tpu import types as rst_types
 from raft_sim_tpu.sim.scan import RunMetrics
 from raft_sim_tpu.types import ClusterState, Mailbox, StepInfo
 from raft_sim_tpu.utils.config import RaftConfig
+
+# TPU minor-tile sublane multiple by element width (the lane dim is always 128
+# wide). Single-sourced here so the cost model (analysis/cost_model.py) and the
+# traffic audit (tools/traffic_audit.py) price the batch-minor layout with the
+# SAME rules -- a padding-model change is one edit, visible to both. 64-bit
+# elements lower as paired 32-bit words on TPU, so they tile like 4-byte
+# elements; the 2x price rides on itemsize, which is what cost-carry-bytes
+# then flags. Covers every token in CONCRETE_DTYPES, so the cost model can't
+# crash on a legal-dtype carry leg.
+SUBLANE = {8: 8, 4: 8, 2: 16, 1: 32}
+
+
+def logical_bytes(shape, itemsize: int) -> int:
+    """shape x itemsize; a scalar is one element."""
+    return math.prod(shape) * itemsize if shape else itemsize
+
+
+def padded_bytes(shape, itemsize: int, batch: int) -> float:
+    """Physical bytes per cluster in the batch-minor layout: `shape + (B,)`
+    with the trailing two dims tiled (sublane x 128 lanes), divided back by B
+    so lane padding amortizes across the batch and the reported overhead is
+    the sublane padding the layout actually pays per cluster."""
+    dims = list(tuple(shape) + (batch,))
+    dims[-1] = -(-dims[-1] // 128) * 128
+    if len(dims) >= 2:
+        sub = SUBLANE[itemsize]
+        dims[-2] = -(-dims[-2] // sub) * sub
+    return math.prod(dims) * itemsize / batch
+
 
 # Dtype tokens legal in a types.py field comment: either a concrete dtype or
 # the name of a policy function in types.py that picks one per config.

@@ -1,4 +1,4 @@
-"""Orchestration: run both passes, apply waivers, build the report.
+"""Orchestration: run the passes, apply waivers, build the report.
 
 `tools/check.py` is the CLI face; this module is the library face (tests call
 it directly). The default waiver file is `analysis/waivers.json` next to this
@@ -9,6 +9,7 @@ package -- intentional exceptions live there with one-line justifications
 from __future__ import annotations
 
 import os
+import time
 
 from raft_sim_tpu.analysis import ast_lint, findings as F, jaxpr_audit
 
@@ -24,20 +25,36 @@ def run_all(
     *,
     do_ast: bool = True,
     do_jaxpr: bool = True,
+    do_cost: bool = True,
     config_names=jaxpr_audit.AUDIT_CONFIGS,
     waivers_path: str | None = DEFAULT_WAIVERS,
 ):
-    """Run the selected passes. Returns (findings, unused_waivers, problems):
-    `problems` are waiver-file format errors (always fatal for the CLI -- a
-    typo'd waiver must not silently stop waiving)."""
+    """Run the selected passes. Returns (findings, unused_waivers, problems,
+    timings): `problems` are waiver-file format errors (always fatal for the
+    CLI -- a typo'd waiver must not silently stop waiving); `timings` is
+    {pass name: wall seconds} for the passes that ran (the CI artifact
+    records it, and tests/test_cost_model.py pins the analyzer's budget)."""
+    from raft_sim_tpu.analysis import cost_model
+
     found: list[F.Finding] = []
     active_rules: set[str] = set()
+    timings: dict[str, float] = {}
+    all_rules = ast_lint.RULES | jaxpr_audit.RULES | cost_model.RULES
     if do_ast:
+        t0 = time.monotonic()
         found.extend(ast_lint.run_pass(package_root()))
+        timings["ast"] = round(time.monotonic() - t0, 2)
         active_rules |= ast_lint.RULES
     if do_jaxpr:
+        t0 = time.monotonic()
         found.extend(jaxpr_audit.run_pass(config_names))
+        timings["jaxpr"] = round(time.monotonic() - t0, 2)
         active_rules |= jaxpr_audit.RULES
+    if do_cost:
+        t0 = time.monotonic()
+        found.extend(cost_model.run_pass(config_names))
+        timings["cost"] = round(time.monotonic() - t0, 2)
+        active_rules |= cost_model.RULES
     unused: list[dict] = []
     problems: list[str] = []
     if waivers_path:
@@ -46,10 +63,10 @@ def run_all(
         # A waiver is only STALE if the pass owning its rule actually ran (a
         # --jaxpr-only run must not condemn the AST pass's waivers). A rule
         # no pass knows -- a typo -- is stale whenever the full gate ran.
-        full = do_ast and do_jaxpr
+        full = do_ast and do_jaxpr and do_cost
         unused = [
             w for w in unused
             if w.get("rule") in active_rules
-            or (full and w.get("rule") not in (ast_lint.RULES | jaxpr_audit.RULES))
+            or (full and w.get("rule") not in all_rules)
         ]
-    return found, unused, problems
+    return found, unused, problems, timings

@@ -46,9 +46,36 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
     )
 
 
+@jax.jit
+def _own_copy(state):
+    """A buffer-distinct copy of the fleet state: run_chunked donates its way
+    through the chunk loop, and this one up-front copy is what keeps the
+    CALLER's arrays alive while the loop consumes its own. A trivial program
+    (one copy op per leaf) -- compiling it costs milliseconds, unlike a
+    second donating/non-donating variant of the scan program would."""
+    return jax.tree.map(jnp.copy, state)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 3, 5))
 def _chunk(cfg: RaftConfig, state: ClusterState, keys: jax.Array, n: int,
            genome=None, seg_len: int = 1):
+    """Input-preserving chunk: the caller's `state` stays valid after the call
+    (tools/repro.py replays from the chunk-START state on a violation, so it
+    must NOT be donated)."""
+    return scan.run_batch_minor(cfg, state, keys, n, genome=genome, seg_len=seg_len)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 5), donate_argnums=(1,))
+def _chunk_donate(cfg: RaftConfig, state: ClusterState, keys: jax.Array, n: int,
+                  genome=None, seg_len: int = 1):
+    """The steady-state chunk: the previous chunk's carry is DONATED back to
+    XLA, so a long-horizon run holds one fleet state in HBM instead of two
+    (at config3 scale, batch=100k x ~4 KB/cluster, double-buffering is ~0.4 GB
+    of dead residency per chunk boundary). `keys` are reused across chunks and
+    are never donated. The cost model's donation audit
+    (analysis/cost_model.py, rule `cost-donation`) pins that this entry point
+    actually aliases its carry buffers -- dropping `donate_argnums` fails the
+    gate statically."""
     return scan.run_batch_minor(cfg, state, keys, n, genome=genome, seg_len=seg_len)
 
 
@@ -69,13 +96,22 @@ def run_chunked(
     (final_state, merged_metrics). `genome`/`seg_len` select the scenario
     input path (scan.run_batch_minor); segment boundaries are driven by the
     absolute tick in state.now, so chunking never shifts a nemesis phase.
+
+    Buffer ownership: the caller's `state` buffers stay valid (the loop takes
+    ONE device copy up front -- trivial next to a single chunk's work -- and
+    owns it), and every state the loop produces is donated to the next chunk,
+    so the steady state holds one fleet in HBM, not two. One consequence: a
+    `state` captured inside `callback` is only valid until the callback
+    returns -- copy (`jax.device_get`) anything a callback needs to keep, as
+    the checkpoint/apply-log consumers already do.
     """
     batch = state.role.shape[0]
     metrics = scan.init_metrics_batch(batch)
     done = 0
+    state = _own_copy(state)
     while done < n_ticks:
         n = min(chunk, n_ticks - done)
-        state, m = _chunk(cfg, state, keys, n, genome, seg_len)
+        state, m = _chunk_donate(cfg, state, keys, n, genome, seg_len)
         metrics = merge_metrics(metrics, m)
         done += n
         if callback is not None and callback(done, state, metrics):

@@ -47,7 +47,7 @@ from jax import lax
 
 from raft_sim_tpu.models import raft_batched
 from raft_sim_tpu.sim import scan
-from raft_sim_tpu.sim.chunked import merge_metrics
+from raft_sim_tpu.sim.chunked import _own_copy, merge_metrics
 from raft_sim_tpu.types import StepInfo
 from raft_sim_tpu.utils.config import RaftConfig
 
@@ -230,8 +230,14 @@ def simulate_windowed(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6, 8))
-def _chunk_t(cfg, state, keys, rec, n, window, ring_k, genome=None, seg_len=1):
+@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6, 8), donate_argnums=(1,))
+def _chunk_t_donate(cfg, state, keys, rec, n, window, ring_k, genome=None,
+                    seg_len=1):
+    """The soak-path steady-state chunk: like `chunked._chunk_donate`, the
+    previous chunk's state is donated so a 10M-tick telemetry run holds ONE
+    fleet in HBM, not two. The recorder is small (K ring slots) and threaded
+    un-donated. Pinned by the cost model's donation audit (`cost-donation`),
+    same as the plain chunk loop."""
     recorder = rec if ring_k else None
     return run_batch_minor_telemetry(
         cfg, state, keys, n, window, recorder, genome=genome, seg_len=seg_len
@@ -260,12 +266,18 @@ def run_chunked_telemetry(
     `callback(ticks_done, state, merged_metrics, records)` receives each
     chunk's records in the public [B, n_windows, ...] layout; returning True
     stops early. Returns (final_state, merged_metrics, recorder).
+
+    Buffer ownership matches `chunked.run_chunked`: the caller's `state` stays
+    valid (one up-front copy, owned by the loop), each chunk's state is
+    donated to the next, and a `state` captured inside `callback` is only
+    valid until the callback returns -- `jax.device_get` anything it keeps.
     """
     batch = state.role.shape[0]
     ring_k = 0 if recorder is None else recorder.tick.shape[0]
     win_per_chunk = max(1, chunk // window)
     metrics = scan.init_metrics_batch(batch)
     done = 0
+    state = _own_copy(state)
     while done < n_ticks:
         left = n_ticks - done
         if left >= window:
@@ -273,7 +285,7 @@ def run_chunked_telemetry(
             w = window
         else:
             n = w = left  # remainder: one final short window
-        state, m, recs, recorder = _chunk_t(
+        state, m, recs, recorder = _chunk_t_donate(
             cfg, state, keys, recorder, n, w, ring_k, genome, seg_len
         )
         metrics = merge_metrics(metrics, m)

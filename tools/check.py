@@ -1,16 +1,23 @@
 """Static-analysis gate: run the raft_sim_tpu invariant auditor.
 
-Two passes (raft_sim_tpu/analysis): Pass A lowers the real step/scan programs
-per config tier and audits the jaxprs (dtype discipline, loop-invariant carry,
-recompile forks); Pass B lints the package source (traced branches, float
-literals) and cross-checks the types.py dtype comments and the checkpoint
-version pin against the live structures. Lowering only -- no XLA compile --
-so the whole gate runs in seconds on CPU. CI runs it before the tier-1 tests.
+Three passes (raft_sim_tpu/analysis): Pass A lowers the real step/scan
+programs per config tier and audits the jaxprs (dtype discipline,
+loop-invariant carry, recompile forks); Pass B lints the package source
+(traced branches, float literals) and cross-checks the types.py dtype
+comments and the checkpoint version pin against the live structures; Pass C
+prices the same lowered programs (scan-carry bytes/tick, live-set peak,
+entry-point donation, roofline at the pinned HBM rate) against the pins in
+tests/golden_cost_model.json. Lowering only -- no device execution, and the
+only XLA compiles are tiny-shape donation probes -- so the whole gate runs
+in well under a minute on CPU. CI runs it before the tier-1 tests.
 
-    python tools/check.py --all                  # both passes, text report
+    python tools/check.py --all                  # all passes, text report
     python tools/check.py --all --format=json    # machine-readable (CI artifact)
     python tools/check.py --ast                  # source + contract rules only
     python tools/check.py --jaxpr --configs config3,config5
+    python tools/check.py --cost                 # Pass C (cost model) only
+    python tools/check.py --cost-diff            # pinned-vs-current cost table
+    python tools/check.py --update-goldens       # re-pin tests/golden_cost_model.json
 
 Exit codes: 0 = no unwaived findings, 1 = unwaived findings (or a stale /
 malformed waiver file), 2 = usage error. Intentional exceptions live in
@@ -31,14 +38,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--all", action="store_true", help="run both passes (default)")
+    ap.add_argument("--all", action="store_true", help="run all passes (default)")
     ap.add_argument("--ast", action="store_true", help="Pass B only (AST + contracts)")
     ap.add_argument("--jaxpr", action="store_true", help="Pass A only (jaxpr audit)")
+    ap.add_argument("--cost", action="store_true", help="Pass C only (cost model)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument(
         "--configs",
         default=None,
-        help="comma-separated preset names for the jaxpr pass "
+        help="comma-separated preset names for the jaxpr/cost passes "
              "(default: the analysis.jaxpr_audit.AUDIT_CONFIGS tiers)",
     )
     ap.add_argument(
@@ -47,14 +55,28 @@ def main(argv=None) -> int:
         help="waiver file (default: raft_sim_tpu/analysis/waivers.json); "
              "'none' disables waiving",
     )
+    ap.add_argument(
+        "--update-goldens", action="store_true",
+        help="regenerate tests/golden_cost_model.json from the current tree "
+             "(the cost-model pins; mirrors tests/test_golden_jaxpr.py "
+             "--update) and exit",
+    )
+    ap.add_argument(
+        "--cost-diff", action="store_true",
+        help="print the pinned-vs-current cost table (bytes/tick, live peak, "
+             "donation) and exit 0 -- the CI failure-triage rendering",
+    )
+    ap.add_argument(
+        "--cost-report", default=None, metavar="PATH",
+        help="also write the full derived cost document (per-leg carry "
+             "model, donation audit, rooflines) as JSON to PATH",
+    )
     args = ap.parse_args(argv)
 
-    from raft_sim_tpu.analysis import jaxpr_audit, run
+    from raft_sim_tpu.analysis import cost_model, jaxpr_audit, run
     from raft_sim_tpu.analysis import findings as F
     from raft_sim_tpu.utils.config import PRESETS
 
-    do_ast = args.all or args.ast or not (args.ast or args.jaxpr)
-    do_jaxpr = args.all or args.jaxpr or not (args.ast or args.jaxpr)
     config_names = jaxpr_audit.AUDIT_CONFIGS
     if args.configs:
         config_names = tuple(c.strip() for c in args.configs.split(","))
@@ -62,23 +84,63 @@ def main(argv=None) -> int:
         if unknown:
             print(f"unknown preset(s) {unknown}", file=sys.stderr)
             return 2
+
+    if args.update_goldens:
+        if args.configs:
+            # A partial golden would fail the full gate as out-of-sync; the
+            # pins always cover every audited tier.
+            print("--update-goldens ignores --configs: the golden file pins "
+                  "ALL audited tiers", file=sys.stderr)
+        path = cost_model.update_golden()
+        print(f"wrote {path} (jax {__import__('jax').__version__}); review "
+              "the diff and commit it alongside the change it pins")
+        return 0
+
+    if args.cost_diff:
+        derived = cost_model.derive_all(config_names)
+        try:
+            with open(cost_model.golden_path()) as f:
+                golden = json.load(f)
+        except (OSError, json.JSONDecodeError) as ex:
+            print(f"golden cost file unreadable: {ex}", file=sys.stderr)
+            golden = {}
+        cost_model.diff_table(derived, golden)
+        return 0
+
+    picked = args.ast or args.jaxpr or args.cost
+    do_ast = args.all or args.ast or not picked
+    do_jaxpr = args.all or args.jaxpr or not picked
+    do_cost = args.all or args.cost or not picked
     waivers_path = run.DEFAULT_WAIVERS
     if args.waivers:
         waivers_path = None if args.waivers == "none" else args.waivers
 
     t0 = time.time()
-    found, unused, problems = run.run_all(
-        do_ast=do_ast, do_jaxpr=do_jaxpr,
+    found, unused, problems, timings = run.run_all(
+        do_ast=do_ast, do_jaxpr=do_jaxpr, do_cost=do_cost,
         config_names=config_names, waivers_path=waivers_path,
     )
     elapsed = time.time() - t0
     unwaived = [f for f in found if not f.waived]
 
+    if args.cost_report and do_cost:
+        with open(args.cost_report, "w") as f:
+            json.dump(cost_model.derive_all(config_names), f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+    elif args.cost_report:
+        print("--cost-report ignored: the cost pass is not selected (add "
+              "--cost or --all)", file=sys.stderr)
+
     if args.format == "json":
         doc = F.report(
             found,
             unused_waivers=unused,
-            extras={"elapsed_s": round(elapsed, 2), "waiver_problems": problems},
+            extras={
+                "elapsed_s": round(elapsed, 2),
+                "pass_elapsed_s": timings,
+                "waiver_problems": problems,
+            },
         )
         print(json.dumps(doc, indent=2))
     else:
@@ -90,10 +152,11 @@ def main(argv=None) -> int:
                   f"matched no finding -- remove it ({w.get('reason')})")
         for p in problems:
             print(f"[WAIVER FILE ERROR] {p}")
+        per_pass = " ".join(f"{k}={v:.1f}s" for k, v in timings.items())
         print(
             f"{len(found)} finding(s): {len(unwaived)} unwaived, "
             f"{len(found) - len(unwaived)} waived, {len(unused)} stale waiver(s) "
-            f"({elapsed:.1f}s)"
+            f"({elapsed:.1f}s: {per_pass})"
         )
     return 1 if (unwaived or unused or problems) else 0
 

@@ -98,17 +98,9 @@ def load_run(path: str, config: str | None = None) -> tuple[str, dict]:
         if data.get("parsed"):
             data = data["parsed"]
         else:
-            import re
+            from raft_sim_tpu.analysis import cost_model
 
-            dec = json.JSONDecoder()
-            rows = {}
-            for mt in re.finditer(r'"(config[A-Za-z0-9_]*)":\s*\{', data.get("tail") or ""):
-                try:
-                    row, _ = dec.raw_decode((data.get("tail") or "")[mt.end() - 1:])
-                except json.JSONDecodeError:
-                    continue
-                if "cluster_ticks_per_s" in row:
-                    rows[mt.group(1)] = row
+            rows = cost_model.bench_matrix(data)
             if not rows:
                 raise SystemExit(f"{path}: bench wrapper carries no recoverable rows")
             data = {"matrix": rows, "workload": None}
@@ -205,7 +197,8 @@ def diff(path_a: str, path_b: str, config: str | None, out=sys.stdout) -> None:
     label_b, b = load_run(path_b, config)
     keys = [k for k in (
         "violations", "cmds", "msgs", "max_commit", "p50_stable_tick",
-        "cluster_ticks_per_s", "mean_commit_latency", "p50_commit_latency",
+        "cluster_ticks_per_s", "predicted_roofline_ticks_per_s",
+        "roofline_headroom", "mean_commit_latency", "p50_commit_latency",
         "lat_p50", "lat_p95", "lat_p99", "lat_excluded", "noop_blocked",
         "lm_skipped_pairs", "multi_leader",
     ) if k in a or k in b]

@@ -4,16 +4,23 @@ work (and, where packing cannot win, the roofline argument).
 Every `lax.scan` tick reads the whole carry (ClusterState + Mailbox +
 RunMetrics) from HBM and writes it back, and materializes the per-tick
 StepInputs; at large N those planes ARE the tick's HBM traffic (docs/PERF.md
-"what the profile says"). This tool enumerates the carry exactly as the
-kernels declare it -- `jax.eval_shape` over `init_state`/`make_inputs`, so the
-accounting can never drift from the real structures -- and prices each leaf
-two ways:
+"what the profile says"). The carry accounting's PRIMARY source is the
+analyzer's cost model (`raft_sim_tpu/analysis/cost_model.py`, Pass C): the
+scan-carry legs are read out of the LOWERED run program itself -- aval
+shapes/dtypes from the scan body, moving-vs-elided derived from identity
+passthrough in the jaxpr, the exact table `tools/check.py --cost` gates
+against tests/golden_cost_model.json. The historical `jax.eval_shape` leaf
+table over `init_state` is retained as a cross-check (derived and hand-priced
+must agree within 1%; asserted in tests/test_cost_model.py, warned about here
+at runtime). Each leaf is priced two ways:
 
   - logical bytes (shape x itemsize), and
   - TPU-padded bytes in the batch-minor layout ([..., B]: the minor dim rides
     the 128-wide lane tile, the second-minor dim pads to the dtype's sublane
     multiple -- 8 for 4-byte, 16 for 2-byte, 32 for 1-byte elements), the
-    physical footprint models/raft_batched.py exists to control.
+    physical footprint models/raft_batched.py exists to control. The padding
+    rules are single-sourced in `analysis/policy.py` (`padded_bytes`), shared
+    with the gated cost model.
 
 It then rebuilds the same table for the DENSE pre-packing layout (votes and
 deliver_mask as [N, N] bool, pre-vote grants riding resp_kind, no pv_grant
@@ -26,6 +33,11 @@ reduction projects past the 3M ticks/s bar, or this audit documents that the
 bool planes were never a large enough fraction of the tick for packing to get
 there (docs/PERF.md "bit-packing audit" section holds the conclusions).
 
+The roofline anchor is no longer a hand table: it derives from the newest
+BENCH_r*.json artifact in the repo root (`cost_model.bench_anchor`), so the
+projections track the bench trajectory; with no artifact present it falls
+back to the pinned round-5 chip numbers with a stderr warning.
+
 Runs on CPU (nothing is executed on device -- eval_shape only):
 
     python tools/traffic_audit.py                     # configs 3/4/5 table
@@ -37,7 +49,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
 
@@ -46,23 +57,28 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-from raft_sim_tpu.analysis.policy import invariant_leaves
+from raft_sim_tpu.analysis import cost_model, jaxpr_audit
+from raft_sim_tpu.analysis.policy import (
+    invariant_leaves, logical_bytes, padded_bytes,
+)
 from raft_sim_tpu.ops import bitplane
 from raft_sim_tpu.sim import faults, scan
 from raft_sim_tpu.types import init_state
 from raft_sim_tpu.utils.config import PRESETS, RaftConfig
 
-# Recorded round-5 throughput per preset (docs/PERF.md history table, real
-# chip, best-of-2): the anchor for the implied-HBM-rate roofline. A config
-# absent here gets bytes accounting but no projection.
-RECORDED_TICKS_PER_S = {
-    "config3": 38.1e6,
-    "config4": 22.7e6,
-    "config5": 2.14e6,
-}
 
-# TPU minor-tile sublane multiple by element width (lane dim is always 128).
-_SUBLANE = {4: 8, 2: 16, 1: 32}
+def roofline_anchor():
+    """(anchors, source): per-config recorded cluster-ticks/s for the
+    implied-HBM-rate roofline. Primary source: the newest BENCH_r*.json
+    artifact (so the anchor updates with every recorded bench round);
+    fallback: the pinned round-5 chip numbers
+    (cost_model.FALLBACK_ANCHOR_R05), with a warning -- a stale anchor must
+    be visible, not silent. A config absent from the anchor gets bytes
+    accounting but no projection."""
+    anchors, source, notes = cost_model.anchor()
+    for n in notes:
+        print(f"traffic_audit: WARNING: {n}", file=sys.stderr)
+    return anchors, source
 
 
 # Loop-invariant carry legs (excluded from the traffic totals: XLA elides
@@ -74,10 +90,38 @@ _SUBLANE = {4: 8, 2: 16, 1: 32}
 _invariant_leaves = invariant_leaves
 
 
+def _derived_carry_rows(cfg: RaftConfig):
+    """(group, name, shape, itemsize) for every MOVING scan-carry leg, derived
+    from the lowered run program by the cost model (the primary source: the
+    same per-leg table `tools/check.py --cost` gates). Shapes are per cluster
+    (the lowering's trailing batch axis stripped); legs the scan body passes
+    through untouched are already excluded -- the jaxpr says so, no hand list
+    involved."""
+    cm = cost_model.carry_model(jaxpr_audit.scan_jaxpr(cfg), batch=1)
+    rows = []
+    for name, leg in cm["legs"].items():
+        if not leg["moving"]:
+            continue
+        group = (
+            "mailbox" if name.startswith("mb.")
+            else "metrics" if name.startswith("metric.")
+            else "state"
+        )
+        rows.append(
+            (group, name, tuple(leg["shape"]), jnp.dtype(leg["dtype"]).itemsize)
+        )
+    return rows
+
+
 def _leaf_rows(cfg: RaftConfig):
     """(group, name, shape, dtype) for every scan-carry leaf + per-tick input,
     taken from the real structures via eval_shape (shapes are per cluster);
-    loop-invariant carry legs (see _invariant_leaves) are dropped."""
+    loop-invariant carry legs (see _invariant_leaves) are dropped.
+
+    Since the cost-model refactor this table is the CROSS-CHECK, not the
+    source of record: `audit()` prices the carry from `_derived_carry_rows`
+    (the lowered program) and warns if this hand table disagrees beyond 1%
+    (tests/test_cost_model.py asserts exact agreement)."""
     key = jax.eval_shape(lambda: jax.random.key(0))
     state = jax.eval_shape(lambda k: init_state(cfg, k), key)
     inputs = jax.eval_shape(
@@ -114,22 +158,10 @@ def _densify(rows, cfg: RaftConfig):
     return out
 
 
-def _logical(shape, isize):
-    return math.prod(shape) * isize if shape else isize
-
-
-def _padded(shape, isize, batch):
-    """Physical bytes per cluster in the batch-minor layout: shape + (B,) with
-    the trailing two dims tiled (sublane x 128 lanes). Divided back by B, so
-    lane padding amortizes across the batch and the reported overhead is the
-    sublane padding the layout actually pays per cluster."""
-    bm = tuple(shape) + (batch,)
-    dims = list(bm)
-    dims[-1] = -(-dims[-1] // 128) * 128
-    if len(dims) >= 2:
-        sub = _SUBLANE[isize]
-        dims[-2] = -(-dims[-2] // sub) * sub
-    return math.prod(dims) * isize / batch
+# The lane/sublane padding rules live in analysis/policy.py now (shared with
+# the gated cost model); these aliases keep this file's call sites readable.
+_logical = logical_bytes
+_padded = padded_bytes
 
 
 def _telemetry_rows(cfg: RaftConfig, ring_k: int):
@@ -178,7 +210,11 @@ def _scenario_rows(s_count: int):
 
 def audit(cfg: RaftConfig, batch: int):
     """Both layouts' per-cluster-tick byte totals. Carry leaves move twice per
-    tick (read + write); inputs once (materialized from the key stream)."""
+    tick (read + write); inputs once (materialized from the key stream).
+    Carry rows come from the derived cost model; the eval_shape hand table is
+    re-priced as a cross-check and any >1% disagreement is warned to stderr
+    (it means the lowered program and the declared structures diverged --
+    exactly what the old hand-only accounting could not see)."""
 
     def total(rows):
         log = pad = 0.0
@@ -188,7 +224,27 @@ def audit(cfg: RaftConfig, batch: int):
             pad += mult * _padded(shape, isize, batch)
         return log, pad
 
-    packed_rows = _leaf_rows(cfg)
+    hand_rows = _leaf_rows(cfg)
+    carry_rows = _derived_carry_rows(cfg)
+    input_rows = [r for r in hand_rows if r[0] == "inputs"]
+    packed_rows = carry_rows + input_rows
+    hand_carry = [r for r in hand_rows if r[0] != "inputs"]
+    d_log, d_pad = total(carry_rows)
+    h_log, h_pad = total(hand_carry)
+    # Compare logical AND padded totals: a divergence can cancel out under
+    # lane/sublane padding (dtype narrowing paired with a pad-up in the
+    # same tile) and would pass a padded-only check.
+    if (h_pad and abs(d_pad - h_pad) > 0.01 * h_pad) or (
+            h_log and abs(d_log - h_log) > 0.01 * h_log):
+        print(
+            f"traffic_audit: WARNING: derived carry pricing ({d_pad:,.0f} B "
+            f"padded / {d_log:,.0f} B logical) disagrees with the eval_shape "
+            f"cross-check ({h_pad:,.0f} B / {h_log:,.0f} B) by >1% -- the "
+            "lowered scan and the declared structures have diverged; trust "
+            "the derived number and fix the drift (tests/test_cost_model.py "
+            "pins agreement)",
+            file=sys.stderr,
+        )
     dense_rows = _densify(packed_rows, cfg)
     packed_log, packed_pad = total(packed_rows)
     dense_log, dense_pad = total(dense_rows)
@@ -217,7 +273,10 @@ def _fmt_bytes(b):
 
 
 def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
-           telemetry_ring: int | None = None, scenario_segments: int | None = None):
+           telemetry_ring: int | None = None, scenario_segments: int | None = None,
+           anchors: dict | None = None, anchor_source: str | None = None):
+    if anchors is None:
+        anchors, anchor_source = roofline_anchor()
     a = audit(cfg, batch)
     w = bitplane.n_words(cfg.n_nodes)
     print(f"\n== {name}: N={cfg.n_nodes} (W={w}), CAP={cfg.log_capacity}, "
@@ -242,10 +301,11 @@ def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
         f"padded {100 * (1 - pp / dp):.1f}%",
         file=out,
     )
-    rec = RECORDED_TICKS_PER_S.get(name)
+    rec = anchors.get(name)
     res = {
         "config": name,
         "n": cfg.n_nodes,
+        "anchor_source": anchor_source,
         "dense_logical": dl,
         "dense_padded": dp,
         "packed_logical": pl,
@@ -263,8 +323,8 @@ def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
             "boolfree_roofline_ticks_per_s": bound,
         }
         print(
-            f"recorded (r05, chip): {rec / 1e6:.2f}M ticks/s -> implied HBM rate "
-            f"{bw / 1e9:.1f} GB/s on the dense carry",
+            f"recorded ({anchor_source}): {rec / 1e6:.2f}M ticks/s -> implied "
+            f"HBM rate {bw / 1e9:.1f} GB/s on the dense carry",
             file=out,
         )
         print(
@@ -352,6 +412,7 @@ def main(argv=None) -> int:
     # parseable JSON line (the bench-artifact lesson: machine output must not
     # interleave with narration).
     table_out = sys.stderr if args.json else sys.stdout
+    anchors, anchor_source = roofline_anchor()
     results = []
     for name in args.configs.split(","):
         name = name.strip()
@@ -361,7 +422,8 @@ def main(argv=None) -> int:
         cfg, batch = PRESETS[name]
         results.append(report(name, cfg, batch, args.top, out=table_out,
                               telemetry_ring=args.telemetry_ring,
-                              scenario_segments=args.scenario))
+                              scenario_segments=args.scenario,
+                              anchors=anchors, anchor_source=anchor_source))
     if args.json:
         print(json.dumps(results))
     return 0
