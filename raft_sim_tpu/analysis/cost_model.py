@@ -406,17 +406,26 @@ def entry_points():
 
       _chunk_donate  donates the chunk carry (the long-horizon hot loop)
       _chunk_t_donate  the telemetry soak loop's chunk: same donation contract
+      _serve_chunk   the standing-fleet serve loop's chunk: donates the fleet
+                     between chunks (a service session must hold ONE fleet in
+                     HBM forever, not two -- ISSUE 6's never-double-buffers
+                     acceptance bullet)
       _chunk         input-preserving ON PURPOSE: tools/repro.py replays from
                      the chunk-start state after a violation
       simulate(+scenario)  seed/genome inputs only -- nothing donatable; the
                      scan carry double-buffers inside one executable, which
                      is XLA's job, not the caller's
     """
+    import dataclasses as _dc
+
+    from raft_sim_tpu.serve import loop as serve_loop
     from raft_sim_tpu.sim import chunked, scan as scan_mod, telemetry
 
     state, keys = _tiny_avals()
     seed = jax.ShapeDtypeStruct((), jnp.int32)
     genome = jaxpr_audit._genome_avals(_TINY_BATCH, 2)
+    serve_cfg = _dc.replace(_TINY_CFG, serve_ingest=True)
+    cmds = jax.ShapeDtypeStruct((_TINY_TICKS,), jnp.int32)
     return (
         ("sim.chunked._chunk_donate", "donated",
          lambda: chunked._chunk_donate.lower(
@@ -424,6 +433,9 @@ def entry_points():
         ("sim.telemetry._chunk_t_donate", "donated",
          lambda: telemetry._chunk_t_donate.lower(
              _TINY_CFG, state, keys, None, _TINY_TICKS, _TINY_TICKS, 0, None, 1)),
+        ("serve.loop._serve_chunk", "donated",
+         lambda: serve_loop._serve_chunk.lower(
+             serve_cfg, state, keys, cmds, _TINY_TICKS)),
         ("sim.chunked._chunk", "not-donated",
          lambda: chunked._chunk.lower(
              _TINY_CFG, state, keys, _TINY_TICKS, None, 1)),
@@ -492,8 +504,12 @@ def donation_audit() -> tuple:
 def derive_program(key: str, closed, kind: str, cfg: RaftConfig, batch: int) -> dict:
     peak, temp = live_peak_bytes(closed)
     entry: dict = {"kind": kind, "live_peak": peak, "temp_bytes": temp}
-    if kind != "scan":
+    if kind not in ("scan", "serve_scan"):
         return entry
+    # serve_scan: the widest scan is the serve loop's inner window scan, whose
+    # carry = the (state, metrics) template + the first-violation aux leg --
+    # so the offer-tick plane legs are priced exactly like every other carry
+    # leg (ISSUE 6: the plane's cost is a gated number, not prose).
     cm = carry_model(closed, batch)
     if cm is None:
         entry["error"] = "no scan found in a scan-kind program"
@@ -532,9 +548,9 @@ def _derive_all(config_names: tuple) -> dict:
     programs = {}
     for name in config_names:
         cfg, batch = PRESETS[name]
-        for prog, closed, kind in jaxpr_audit.programs(name, cfg):
+        for prog, closed, kind, rule_cfg in jaxpr_audit.programs(name, cfg):
             key = prog.split("jaxpr:", 1)[1]
-            programs[key] = derive_program(key, closed, kind, cfg, batch)
+            programs[key] = derive_program(key, closed, kind, rule_cfg, batch)
     anchors, source, notes = anchor()
     for key, entry in programs.items():
         cfg_name, prog = key.split("/", 1)

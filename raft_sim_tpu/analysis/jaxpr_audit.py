@@ -79,6 +79,9 @@ LARGE_CONST_BYTES = 64 * 1024
 # have no effect on the audited structure (shapes scale, programs don't).
 _AUDIT_BATCH = 8
 _AUDIT_TICKS = 32
+# Telemetry window of the audited SERVE program (the served scan folds window
+# records on device, telemetry-style): shape-like static, ticks must divide.
+_AUDIT_WINDOW = 16
 # Canonical scenario-program shape for the audited genome path: S segments of
 # SEG_LEN ticks. S/seg_len are shape-like statics (a different S is a new
 # program, like a different batch); genome VALUES are traced and can never
@@ -173,14 +176,51 @@ def scenario_scan_jaxpr(
     )(seed, gen)
 
 
+def serve_variant(cfg: RaftConfig) -> RaftConfig:
+    """The serve-mode config a tier's serve program is audited under (external
+    ingest replaces the scheduled cadence; the offer-tick plane goes live)."""
+    from raft_sim_tpu.serve.loop import serve_config
+
+    return serve_config(cfg)
+
+
+@functools.lru_cache(maxsize=None)
+def serve_scan_jaxpr(
+    cfg: RaftConfig,
+    batch: int = _AUDIT_BATCH,
+    ticks: int = _AUDIT_TICKS,
+    window: int = _AUDIT_WINDOW,
+):
+    """ClosedJaxpr of the standing-fleet serve program
+    (`serve.loop.simulate_serve`: init + served windowed scan). The offer
+    plane enters as a [ticks] int32 aval -- command VALUES are invisible to
+    lowering, so one compiled chunk program serves the whole session and a
+    multi-chunk `driver serve` run compiles nothing after warmup (the claim
+    the distinct-lowering pin gates). NOTE: callers pass the SERVE-mode
+    config (`serve_variant`), which is also the config the carry rules run
+    under -- the offer-tick plane legs move here by design."""
+    from raft_sim_tpu.serve import loop as serve_loop
+
+    seed = jax.ShapeDtypeStruct((), jnp.int32)
+    cmds = jax.ShapeDtypeStruct((ticks,), jnp.int32)
+    return jax.make_jaxpr(
+        lambda s, c: serve_loop.simulate_serve(cfg, s, batch, c, window)
+    )(seed, cmds)
+
+
 def programs(name: str, cfg: RaftConfig):
     """The audited programs for one config tier: both step kernels, the full
-    scan, and the scenario (genome-path) scan. Yields
-    (program_name, closed_jaxpr, kind)."""
-    yield f"jaxpr:{name}/step", step_jaxpr(cfg, batched=False), "step"
-    yield f"jaxpr:{name}/step_b", step_jaxpr(cfg, batched=True), "step"
-    yield f"jaxpr:{name}/simulate", scan_jaxpr(cfg), "scan"
-    yield f"jaxpr:{name}/scenario_simulate", scenario_scan_jaxpr(cfg), "scan"
+    scan, the scenario (genome-path) scan, and the standing-fleet serve scan.
+    Yields (program_name, closed_jaxpr, kind, rule_cfg) -- `rule_cfg` is the
+    config the per-program rules (carry passthrough/dtype, input pricing) run
+    under: the tier's own config, except for the serve program, which is
+    audited under its serve-mode variant (offer-tick plane live)."""
+    yield f"jaxpr:{name}/step", step_jaxpr(cfg, batched=False), "step", cfg
+    yield f"jaxpr:{name}/step_b", step_jaxpr(cfg, batched=True), "step", cfg
+    yield f"jaxpr:{name}/simulate", scan_jaxpr(cfg), "scan", cfg
+    yield f"jaxpr:{name}/scenario_simulate", scenario_scan_jaxpr(cfg), "scan", cfg
+    scfg = serve_variant(cfg)
+    yield f"jaxpr:{name}/serve_simulate", serve_scan_jaxpr(scfg), "serve_scan", scfg
 
 
 # ------------------------------------------------------------- jaxpr walking
@@ -354,20 +394,27 @@ def _find_scan(jaxpr, num_carry: int):
     return None
 
 
-def check_carry_passthrough(program: str, closed, cfg: RaftConfig) -> list[Finding]:
+def check_carry_passthrough(
+    program: str, closed, cfg: RaftConfig, extra_legs: int = 0
+) -> list[Finding]:
     """Rule carry-passthrough: in the run scan's body, every leg
     policy.invariant_leaves names for this config must be the SAME var in and
     out (identity passthrough -- XLA then elides it from the per-tick HBM
     round trip). Also rule carry-dtype: carried state planes hold their policy
-    dtypes."""
+    dtypes. `extra_legs` selects a TICK loop whose carry rides auxiliary legs
+    after the (state, metrics) template -- the serve program's inner window
+    scan carries the first-violation tick (serve/loop.py), so its tick loop
+    has len(names) + 1 legs while its outer window loop (where passthrough
+    legs are fresh scan outputs by construction) has exactly len(names)."""
     names = policy.carry_leaf_names()
-    eqn = _find_scan(closed.jaxpr, len(names))
+    want = len(names) + extra_legs
+    eqn = _find_scan(closed.jaxpr, want)
     if eqn is None:
         return [Finding(
             rule="carry-passthrough",
             path=program,
             message=(
-                f"no scan with the expected {len(names)}-leg carry found; the "
+                f"no scan with the expected {want}-leg carry found; the "
                 "run-loop structure changed -- update analysis/policy.py's "
                 "carry template alongside it"
             ),
@@ -451,6 +498,10 @@ def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
         for label, lower in (
             ("simulate", scan_jaxpr),
             ("scenario_simulate", scenario_scan_jaxpr),
+            # The serve loop's zero-recompiles-after-warmup claim, statically:
+            # a tuned value leaking into the serve chunk's structure would
+            # recompile the standing fleet mid-session.
+            ("serve_simulate", lambda c: serve_scan_jaxpr(serve_variant(c))),
         ):
             h_base = structural_hash(lower(base))
             h_var = structural_hash(lower(variant))
@@ -485,12 +536,17 @@ def run_pass(config_names=AUDIT_CONFIGS, fork_pairs=FORK_PAIRS) -> list[Finding]
     out: list[Finding] = []
     for name in config_names:
         cfg, _ = PRESETS[name]
-        for prog, closed, kind in programs(name, cfg):
+        for prog, closed, kind, rule_cfg in programs(name, cfg):
             out.extend(check_float_ops(prog, closed))
             if kind == "step":
-                out.extend(check_plane_widening(prog, closed, cfg))
+                out.extend(check_plane_widening(prog, closed, rule_cfg))
             else:
-                out.extend(check_carry_passthrough(prog, closed, cfg))
+                # The serve program's tick loop rides one auxiliary carry leg
+                # (the window's first-violation tick -- serve/loop.py).
+                extra = 1 if kind == "serve_scan" else 0
+                out.extend(
+                    check_carry_passthrough(prog, closed, rule_cfg, extra_legs=extra)
+                )
             out.extend(check_large_constants(prog, closed))
     out.extend(check_recompile_forks(fork_pairs))
     return out

@@ -201,8 +201,15 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
         }
     if not cfg.client_redirect:
         inv |= {"client_pend", "client_dst"}
-    if cfg.client_interval == 0:
-        inv |= {"lat_frontier"}
+    if not cfg.track_offer_ticks:
+        # Offer-tick plane off: the latency stamps (log plane, wire window,
+        # pipeline stamps) and the dedup frontier are all dead weight the tick
+        # must pass through untouched.
+        inv |= {"log_tick", "mb.ent_tick", "client_tick", "lat_frontier"}
+    elif not cfg.client_redirect:
+        # Plane on but no redirect pipeline: stamps never ride client slots
+        # (direct acceptance stamps at injection).
+        inv |= {"client_tick"}
     return inv
 
 

@@ -82,6 +82,7 @@ class Session:
         self.apply_writer = None
         self.telemetry = None  # TelemetrySink (attach_telemetry)
         self._tel_rec = None  # flight-recorder carry (batch-minor)
+        self._deltas = None  # serve.DeltaStream (offer's commit-ack watcher)
         self.reset()
 
     def reset(self) -> None:
@@ -92,6 +93,7 @@ class Session:
         self.state = init_batch(self.cfg, k_init, self.batch)
         self.keys = jax.random.split(k_run, self.batch)
         self.metrics = scan.init_metrics_batch(self.batch)
+        self._deltas = None  # a rebuilt experiment gets a fresh ack watermark
         self._apply_sharding()
         # A rebuilt experiment gets a rebuilt export stream: re-attach truncates
         # the files and zeroes the writer's frontier (a stale frontier would
@@ -233,25 +235,52 @@ class Session:
         clusters whose live leader appended the value ON the offer tick (under
         client_redirect acceptance usually lands on a LATER tick, after the
         bounces, so this undercounts there -- watch `committed` instead);
-        `committed` counts clusters where the value NEWLY committed relative to a
-        pre-offer snapshot, after up to `wait` further ticks -- the ack the
+        `committed` counts clusters whose COMMIT-DELTA STREAM (the device-side
+        node-0 apply stream, serve/deltas.py) delivered the value after the
+        offer, stepping up to `wait` further ticks -- the per-entry ack the
         reference's commit watch was meant to deliver and never did
-        (log.clj:83-87, bug 2.3.9). Scheduled commands encode their offer tick as
-        their value, so prefer values outside that range (e.g. <= -3) when
-        client_interval > 0: a colliding value can be indistinguishable from an
-        already-committed scheduled entry (the snapshot makes that a conservative
-        undercount, never a false positive).
+        (log.clj:83-87, bug 2.3.9; VERDICT missing #2). Acks match by
+        (value, offer stamp) pair: the watermark excludes everything committed
+        BEFORE the offer, and the stamp -- this offer's tick + 1, riding the
+        v21 log_tick plane -- excludes colliding values committed DURING the
+        wait window (e.g. a scheduled command whose value happens to equal
+        this payload), so an ack is THIS entry, exactly. ANY int32 payload
+        except the NIL/NOOP sentinels is legal -- the old "prefer values
+        <= -3" collision caveat is gone. Acks follow node 0's commit, which
+        trails the leader's by up to a heartbeat round trip (and stalls while
+        node 0 is crashed): size `wait` accordingly.
         """
         value = int(value)
-        from raft_sim_tpu.types import NIL, NOOP
+        from raft_sim_tpu.serve.ingest import check_value
 
-        if value in (NIL, NOOP):
-            raise ValueError(
-                f"command value {value} collides with the NIL/NOOP sentinels"
-            )
-        if not -(2**31) <= value < 2**31:
-            raise ValueError(f"command value must fit int32, got {value}")
-        before = self._committed_mask(value)
+        check_value(value)  # same NIL/NOOP/int32 rule as the serve ingest
+        if self._deltas is None:
+            from raft_sim_tpu.serve.deltas import DeltaStream
+
+            self._deltas = DeltaStream(self.batch, depth=32)
+        # Only commits that happen AFTER this offer can ack it.
+        self._deltas.skip_to_now(self.state)
+        # The fleet ticks in lockstep: the offered entry's stamp is the shared
+        # pre-offer `now` + 1 everywhere it lands (redirect bounces carry the
+        # stamp of the OFFER tick, not the acceptance tick). Without the tick
+        # plane (track_offer_ticks off) stamps are all zero and the match
+        # falls back to value-only: no scheduled traffic exists to collide
+        # with (client_interval == 0), and skip_to_now excludes everything
+        # committed anywhere pre-offer -- what can still alias is a PRIOR
+        # offer of the same value accepted but uncommitted at offer time (the
+        # snapshot-diff poll this replaces had the identical caveat; tracked
+        # configs are exact).
+        track = self.cfg.track_offer_ticks
+        stamp = int(np.asarray(self.state.now).ravel()[0]) + 1
+        acked: set[int] = set()
+
+        def fresh() -> int:
+            for row in self._deltas.drain(self.state):
+                for v, tk in zip(row["values"], row["ticks"]):
+                    if v == value and (not track or tk == stamp):
+                        acked.add(row["cluster"])
+            return len(acked)
+
         self.state, self.metrics, accepted = _offer_tick(
             self.cfg, self.state, self.keys, self.metrics, value
         )
@@ -260,7 +289,6 @@ class Session:
             # current even when offer() is the session's last action.
             self.apply_writer.update(self.state)
         accepted = int(np.sum(np.asarray(accepted)))
-        fresh = lambda: int((self._committed_mask(value) & ~before).sum())
         committed, waited = fresh(), 0
         # Direct mode: commitment can only reach the same-tick acceptance count.
         # Redirect mode: acceptance trickles in over the bounces, so keep
@@ -275,7 +303,10 @@ class Session:
     def _committed_mask(self, value: int) -> np.ndarray:
         """[batch] bool: clusters in which `value` is a committed live entry
         (host-side ring scan; entries compacted past the base are no longer
-        attributable)."""
+        attributable). SUPERSEDED by the commit-delta stream for offer() acks
+        (the full-state device_get + scan this does per probe is exactly what
+        serve/deltas.py removes); kept as the snapshot-diff CROSS-CHECK the
+        delta tests compare against (tests/test_serve.py)."""
         st = jax.device_get(self.state)
         lv = np.asarray(st.log_val)  # [B, N, CAP]
         commit = np.asarray(st.commit_index)[:, :, None]
@@ -326,6 +357,7 @@ class Session:
         self.apply_writer = None
         self.telemetry = None
         self._tel_rec = None
+        self._deltas = None
         self.cfg = cfg
         self.batch = state.role.shape[0]
         self.seed = seed
@@ -551,6 +583,72 @@ def _scenario_shrink(args, ap) -> int:
     return 0
 
 
+def _serve(args, ap) -> int:
+    """`serve`: the standing-fleet service loop (docs/SERVE.md). A long-lived
+    fleet accepts streamed client commands between chunks (JSONL source, '-'
+    = stdin) and continuously streams telemetry windows + commit deltas to
+    the schema'd sink. Zero recompiles after the first chunk: the chunk
+    program is fixed, commands are data."""
+    from raft_sim_tpu.parallel import summarize
+    from raft_sim_tpu.serve import CommandSource, ServeSession, jsonl_commands
+    from raft_sim_tpu.serve.loop import serve_config
+
+    cfg, batch = build_config(args)
+    cfg = serve_config(cfg)
+    if args.source != "-":
+        # Fail fast: jsonl_commands opens lazily (first next_chunk), which is
+        # AFTER the session has compiled and run its warmup -- a typo'd path
+        # must not cost minutes before erroring.
+        try:
+            open(args.source).close()
+        except OSError as ex:
+            ap.error(f"--source: {ex}")
+    sink = None
+    if args.sink:
+        from raft_sim_tpu.utils.telemetry_sink import TelemetrySink
+
+        sink = TelemetrySink(
+            args.sink, cfg, seed=args.seed or 0, batch=batch,
+            window=args.window, ring=0, source="serve",
+        )
+    try:
+        sess = ServeSession(
+            cfg, batch=batch, seed=args.seed or 0, chunk=args.chunk,
+            window=args.window, delta_depth=args.delta_depth, sink=sink,
+            warmup_ticks=args.warmup,
+        )
+    except ValueError as ex:
+        ap.error(str(ex))
+    source = CommandSource(jsonl_commands(args.source))
+
+    def progress(st):
+        if args.progress:
+            print(
+                f"  chunk {st['chunks']}: {st['ticks']} ticks, "
+                f"{st['deltas_exported']} deltas, "
+                f"violations={st['violations']}",
+                file=sys.stderr,
+            )
+
+    try:
+        stats = sess.serve(
+            source, chunks=args.chunks, drain_chunks=args.drain_chunks,
+            progress=progress,
+        )
+    except ValueError as ex:
+        ap.error(str(ex))
+    out = summarize(sess.metrics)._asdict()
+    out.update(stats)
+    if stats["wall_s"] > 0:
+        out["cluster_ticks_per_s"] = round(
+            batch * stats["ticks"] / stats["wall_s"], 1
+        )
+    if args.sink:
+        out["sink"] = args.sink
+    print(json.dumps(out))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="raft_sim_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -601,6 +699,47 @@ def main(argv=None) -> int:
     _add_config_flags(run_p)
 
     sub.add_parser("presets", help="list the BASELINE config presets")
+
+    serve_p = sub.add_parser(
+        "serve",
+        help="standing-fleet service loop: streamed client ingest between "
+             "chunks, telemetry windows + commit deltas streamed out "
+             "(docs/SERVE.md)",
+    )
+    serve_p.add_argument("--source", metavar="FILE", default="-",
+                         help="JSONL command source: one command per line, a "
+                              "bare int or {\"value\": v}; '-' = stdin "
+                              "(default)")
+    serve_p.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    serve_p.add_argument("--batch", type=int, default=None)
+    serve_p.add_argument("--seed", type=int, default=None)
+    serve_p.add_argument("--chunk", type=int, default=256,
+                         help="ticks per device chunk (the ingest<->export "
+                              "exchange cadence; default 256)")
+    serve_p.add_argument("--window", type=int, default=64,
+                         help="telemetry window ticks (must divide --chunk; "
+                              "default 64)")
+    serve_p.add_argument("--chunks", type=int, default=None,
+                         help="stop after N chunks (default: run until the "
+                              "source is exhausted + --drain-chunks)")
+    serve_p.add_argument("--drain-chunks", type=int, default=4,
+                         help="empty chunks run after source exhaustion so "
+                              "trailing commits flush through the delta "
+                              "stream (default 4)")
+    serve_p.add_argument("--warmup", type=int, default=0, metavar="TICKS",
+                         help="ticks simulated before the first offer (elect "
+                              "leaders so early offers are not dropped)")
+    serve_p.add_argument("--delta-depth", type=int, default=64,
+                         help="per-cluster commit-delta buffer depth per "
+                              "extraction round (backpressure bound, not a "
+                              "loss bound; default 64)")
+    serve_p.add_argument("--sink", metavar="DIR", default=None,
+                         help="stream telemetry windows (windows.jsonl) and "
+                              "commit deltas (deltas.jsonl) to DIR under the "
+                              "telemetry sink schema")
+    serve_p.add_argument("--backend", default="auto", metavar="NAME")
+    serve_p.add_argument("--progress", action="store_true")
+    _add_config_flags(serve_p)
 
     sc = sub.add_parser(
         "scenario",
@@ -671,6 +810,10 @@ def main(argv=None) -> int:
             "search": _scenario_search,
             "shrink": _scenario_shrink,
         }[args.scmd](args, ap)
+
+    if args.cmd == "serve":
+        select_backend(args.backend)
+        return _serve(args, ap)
 
     if args.cmd == "presets":
         for name, (cfg, batch) in sorted(PRESETS.items()):

@@ -81,6 +81,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     """Advance one cluster by one tick. Pure; jit/vmap/scan-safe."""
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
+    track = cfg.track_offer_ticks  # static: offer-tick plane + latency metric active
     ids = jnp.arange(n, dtype=jnp.int32)
     eye = jnp.eye(n, dtype=bool)
     eye_p = bitplane.eye(n)  # [N, W] packed self-bit rows (votes plane layout)
@@ -230,6 +231,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     ws_in = mb.ent_start[sel_idx]  # [N]
     w_term = mb.ent_term[sel_idx]  # [N, E]
     w_val = mb.ent_val[sel_idx]
+    w_tick = mb.ent_tick[sel_idx] if track else None
     prev_i = jnp.where(ae_norm, ws_in + j_nn, 0)
     lcommit = jnp.where(ae_norm, mb.req_commit[sel_idx], 0)
     n_ent = jnp.where(ae_norm, jnp.clip(mb.ent_count[sel_idx] - j_nn, 0, e), 0)
@@ -241,6 +243,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     off = jnp.clip(j_nn, 0, e - 1)  # j = E only when n_ent = 0 (fully masked)
     ent_term_in = log_ops.window(w_term, off, e)  # [N, E]
     ent_val_in = log_ops.window(w_val, off, e)
+    ent_tick_in = log_ops.window(w_tick, off, e) if track else None
 
     # A valid AE from the current term makes candidates (and pre-candidates)
     # step down and identifies the leader (core.clj:121-123, minus the :follwer
@@ -305,6 +308,13 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     else:
         log_term_arr = log_ops.write_window(s.log_term, prev_i, ent_term_in, wmask)
         log_val_arr = log_ops.write_window(s.log_val, prev_i, ent_val_in, wmask)
+    # The offer-stamp plane replicates with the entries it tags (same masks, so
+    # it can never diverge from the value plane's slot occupancy).
+    if track:
+        wwr = log_ops.write_window_r if comp else log_ops.write_window
+        log_tick_arr = wwr(s.log_tick, prev_i, ent_tick_in, wmask)
+    else:
+        log_tick_arr = s.log_tick  # untouched: loop-invariant carry leg
 
     # Follower commit: min(leaderCommit, index of last new entry), monotonic
     # (the reference's apply-entries! commits everything unconditionally, bug 2.3.6).
@@ -477,27 +487,29 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     )
 
     # ---- offer->commit latency (client workloads only) ---------------------------
-    # Each client entry's value encodes its offer tick (faults.make_inputs), so
-    # the live leader's commit advancement this tick contributes
-    # (now - offer_tick) per newly committed client entry -- the measurement the
-    # reference's commit watch was meant to feed (log.clj:83-87, never fired, bug
-    # 2.3.9). Read before compaction/injection can touch slots (same aliasing
-    # rule as the checksum pass).
-    if cfg.client_interval > 0:
+    # Each client entry's offer stamp rides the log_tick plane (phase 6 writes
+    # it at injection; AE replication carries it via Mailbox.ent_tick), so the
+    # live leader's commit advancement this tick contributes (now - offer_tick)
+    # per newly committed client entry -- the measurement the reference's
+    # commit watch was meant to feed (log.clj:83-87, never fired, bug 2.3.9).
+    # VALUES are never read here: payloads are arbitrary int32 (VERDICT
+    # missing #1 -- a value colliding with a tick can no longer corrupt the
+    # histogram). Read before compaction/injection can touch slots (same
+    # aliasing rule as the checksum pass).
+    if track:
         sl = jnp.arange(cap, dtype=jnp.int32)[None, :]
         abs1 = (base[:, None] + (sl - base[:, None]) % cap + 1) if comp else (sl + 1)
         # Dedup across leader changes AND restarts: a freshly elected leader's
         # own commit trails the cluster's prior frontier and would re-count
         # entries its predecessor already reported, so only entries above the
         # CARRIED monotone frontier contribute (the per-node commit vector is
-        # restart-mutable -- ClusterState.lat_frontier). Only plausibly
-        # tick-encoded values count (offer ticks lie in (0, now)): manual
-        # Session.offer payloads outside that range are excluded instead of
-        # decoding as garbage latencies.
+        # restart-mutable -- ClusterState.lat_frontier). Stamps are offer
+        # tick + 1, always in (0, now] at commit time; slots holding no client
+        # entry (no-ops, unwritten) carry stamp 0 and fall out of `cli`.
         newly = (abs1 > s.lat_frontier) & (abs1 <= commit[:, None])
-        cli = (log_val_arr >= 1) & (log_val_arr <= s.now)  # tick-plausible values
+        cli = (log_tick_arr >= 1) & (log_tick_arr <= s.now)  # client-stamped slots
         lm = (is_leader & inp.alive)[:, None] & newly & cli
-        lats = jnp.where(lm, s.now - log_val_arr + 1, 0)  # [N, CAP]
+        lats = jnp.where(lm, s.now - log_tick_arr + 1, 0)  # [N, CAP]
         lat_sum = jnp.sum(lats).astype(jnp.int32)
         lat_cnt = jnp.sum(lm).astype(jnp.int32)
         # Coverage gap counter (StepInfo.lat_excluded): client entries the
@@ -616,6 +628,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         fresh = (inp.client_cmd != NIL) & first_free
         pend = jnp.where(fresh, inp.client_cmd, s.client_pend)  # [K]
         tgt = jnp.where(fresh, inp.client_target, s.client_dst)
+        # Offer stamp rides the slot beside the payload: latency is measured
+        # from the OFFER tick, and the bounces happen after it.
+        ptick = jnp.where(fresh, s.now + 1, s.client_tick) if track else None
         active = pend != NIL
         tgt_oh = active[:, None] & (tgt[:, None] == ids[None, :])  # [K, N]
         low_k = jnp.min(jnp.where(tgt_oh, kk[:, None], kdim), axis=0)  # [N]
@@ -623,6 +638,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         client_ok = (low_k < kdim) & node_ok  # [N] nodes accepting a slot
         sel_k = tgt_oh & (kk[:, None] == low_k[None, :]) & node_ok[None, :]  # [K, N]
         wval_cl = jnp.sum(jnp.where(sel_k, pend[:, None], 0), axis=0)  # [N]
+        wtick_cl = (
+            jnp.sum(jnp.where(sel_k, ptick[:, None], 0), axis=0) if track else None
+        )
         accepted_k = jnp.any(sel_k, axis=1)  # [K]
         # Distinct slots hold distinct offers: the count is exact (the direct
         # client's any() collapses split-brain double-accepts of ONE offer).
@@ -637,15 +655,20 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         client_dst = jnp.where(
             pend_on, jnp.where(tgt_up & (tgt_ld != NIL), tgt_ld, inp.client_bounce), 0
         )
+        client_tick = jnp.where(pend_on, ptick, 0) if track else s.client_tick
     else:
         client_ok = (inp.client_cmd != NIL) & is_leader & inp.alive & room & ~noop
         wval_cl = jnp.broadcast_to(inp.client_cmd, (n,))
+        # Direct mode accepts on the offer tick itself: stamp = now + 1 (the
+        # same stamp the redirect pipeline records at slot entry).
+        wtick_cl = jnp.broadcast_to(s.now + 1, (n,)) if track else None
         # any(), not sum(): during a split-brain window two live leaders can
         # both accept the same offered command; that is ONE offer accepted, and
         # the offered-vs-committed audit counts offers.
         cmds_cnt = jnp.any(client_ok).astype(jnp.int32)
         client_pend = s.client_pend
         client_dst = s.client_dst
+        client_tick = s.client_tick
     do_write = noop | client_ok
     wval = jnp.where(noop, NOOP, wval_cl)
     inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)
@@ -653,6 +676,12 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     log_val_arr = log_val_arr.at[ids, inj_pos].set(
         jnp.broadcast_to(wval, (n,)), mode="drop"
     )
+    if track:
+        # No-op entries carry stamp 0: protocol filler, never a client offer.
+        wtick = jnp.where(noop, 0, wtick_cl)
+        log_tick_arr = log_tick_arr.at[ids, inj_pos].set(
+            jnp.broadcast_to(wtick, (n,)), mode="drop"
+        )
     log_len = log_len + do_write
 
     # ---- phase 7: timers (generate-timeout core.clj:171-174; dispatch :193-195) ----
@@ -765,6 +794,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     wread = log_ops.window_r if comp else log_ops.window
     out_ent_term = jnp.where(ship_used, wread(log_term_arr, ws, e), 0)
     out_ent_val = jnp.where(ship_used, wread(log_val_arr, ws, e), 0)
+    out_ent_tick = (
+        jnp.where(ship_used, wread(log_tick_arr, ws, e), 0) if track
+        else mb.ent_tick  # zeros, loop-invariant carry component
+    )
 
     # Responses: vr_out/ar_out are [request-sender, request-receiver], which IS the
     # response orientation [response-receiver, responder] (the reference's resp-chan
@@ -800,6 +833,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
         ent_val=out_ent_val,
+        ent_tick=out_ent_tick,
         # Without compaction the snapshot header is dead weight: pass the zeros
         # through untouched so XLA sees a loop-invariant carry component.
         req_base=jnp.where(send_append, base, 0) if comp else mb.req_base,
@@ -833,12 +867,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         base_chk=bchk,
         log_term=log_term_arr,
         log_val=log_val_arr,
+        log_tick=log_tick_arr,
         log_len=log_len,
         clock=clock,
         deadline=deadline,
         heard_clock=heard,
         client_pend=client_pend,
         client_dst=client_dst,
+        client_tick=client_tick,
         lat_frontier=lat_frontier,
         now=s.now + 1,
         mailbox=new_mb,

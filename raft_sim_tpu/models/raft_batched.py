@@ -59,6 +59,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     """
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
+    track = cfg.track_offer_ticks  # static: offer-tick plane + latency metric active
     b = s.role.shape[-1]
     # All iota-style constants are built at their final rank (log_ops.iota): Mosaic
     # cannot lower unit-dim-appending reshapes, and this module doubles as the
@@ -187,12 +188,15 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     lcommit = pick_h(mb.req_commit)
     prev_i = jnp.where(ae_norm, ws_in + j_nn, 0)
     n_ent = jnp.where(ae_norm, jnp.clip(pick_h(mb.ent_count) - j_nn, 0, e), 0)
-    # One masked reduction selects BOTH window planes (same one-hot mask): terms
-    # and values ride a single [N, N, 2E, B] pass, split after.
-    ent_tv = jnp.concatenate([mb.ent_term, mb.ent_val], axis=1)  # [N, 2E, B]
+    # One masked reduction selects EVERY window plane (same one-hot mask):
+    # terms and values -- plus offer stamps when the tick plane is live --
+    # ride a single [N, N, (2|3)E, B] pass, split after.
+    planes = [mb.ent_term, mb.ent_val] + ([mb.ent_tick] if track else [])
+    ent_tv = jnp.concatenate(planes, axis=1)  # [N, (2|3)E, B]
     w_tv = jnp.sum(jnp.where(sel[:, :, None, :], ent_tv[:, None], 0), axis=0)
     w_term_in = w_tv[:, :e]  # [N, E, B]
-    w_val_in = w_tv[:, e:]
+    w_val_in = w_tv[:, e:2 * e]
+    w_tick_in = w_tv[:, 2 * e:] if track else None
     # prev term via ext[k] = term of 1-based entry ws+k: k=0 is the sender's
     # ent_prev_term, k>=1 the shared window slots; one-hot over the E+1 offsets.
     ext = jnp.concatenate(
@@ -204,6 +208,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     off = jnp.clip(j_nn, 0, e - 1)  # j = E only when n_ent = 0 (fully masked)
     ent_term_in = log_ops.window_b(w_term_in, off, e)  # [N, E, B]
     ent_val_in = log_ops.window_b(w_val_in, off, e)
+    ent_tick_in = log_ops.window_b(w_tick_in, off, e) if track else None
 
     if cfg.pre_vote:
         stepdown = (role == CANDIDATE) | (role == PRECANDIDATE)
@@ -255,9 +260,19 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         log_val_arr = log_ops.write_window_rb(
             s.log_val, prev_i, ent_val_in, ae_ok, lo, n_acc
         )
+        if track:
+            log_tick_arr = log_ops.write_window_rb(
+                s.log_tick, prev_i, ent_tick_in, ae_ok, lo, n_acc
+            )
     else:
         log_term_arr = log_ops.write_window_b(s.log_term, prev_i, ent_term_in, ae_ok, n_ent)
         log_val_arr = log_ops.write_window_b(s.log_val, prev_i, ent_val_in, ae_ok, n_ent)
+        if track:
+            log_tick_arr = log_ops.write_window_b(
+                s.log_tick, prev_i, ent_tick_in, ae_ok, n_ent
+            )
+    if not track:
+        log_tick_arr = s.log_tick  # untouched: loop-invariant carry leg
 
     last_new = jnp.minimum(prev_i + n_acc, log_len)
     commit = jnp.where(
@@ -421,17 +436,18 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     )
 
     # ---- offer->commit latency (client workloads only; raft.py) ------------------
-    if cfg.client_interval > 0:
+    if track:
         sl = iota((1, cap, 1), 1)
         if comp:
             abs1 = base[:, None, :] + (sl - base[:, None, :]) % cap + 1
         else:
             abs1 = sl + 1
-        # Carried-frontier dedup + tick-encoded value gate (raft.py).
+        # Carried-frontier dedup; stamps read from the offer-tick plane, never
+        # from values (raft.py).
         newly = (abs1 > s.lat_frontier[None, None, :]) & (abs1 <= commit[:, None, :])
-        cli = (log_val_arr >= 1) & (log_val_arr <= s.now[None, None, :])
+        cli = (log_tick_arr >= 1) & (log_tick_arr <= s.now[None, None, :])
         lm = (is_leader & inp.alive)[:, None, :] & newly & cli
-        lats = jnp.where(lm, s.now[None, None, :] - log_val_arr + 1, 0)  # [N, CAP, B]
+        lats = jnp.where(lm, s.now[None, None, :] - log_tick_arr + 1, 0)  # [N, CAP, B]
         lat_sum = jnp.sum(lats, axis=(0, 1)).astype(jnp.int32)
         lat_cnt = jnp.sum(lm, axis=(0, 1)).astype(jnp.int32)
         # Coverage gap counter: crossed-but-unattributed client entries, read
@@ -510,6 +526,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         fresh = (inp.client_cmd != NIL)[None, :] & first_free
         pend = jnp.where(fresh, inp.client_cmd[None, :], s.client_pend)  # [K, B]
         tgt = jnp.where(fresh, inp.client_target[None, :], s.client_dst)
+        # Offer stamp rides the slot beside the payload (raft.py phase 6).
+        ptick = (
+            jnp.where(fresh, (s.now + 1)[None, :], s.client_tick) if track else None
+        )
         active = pend != NIL
         tgt_oh = active[:, None, :] & (tgt[:, None, :] == iota((1, n, 1), 1))  # [K, N, B]
         low_k = jnp.min(jnp.where(tgt_oh, kk3, kdim), axis=0)  # [N, B]
@@ -517,6 +537,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         client_ok = (low_k < kdim) & node_ok  # [N, B] nodes accepting a slot
         sel_k = tgt_oh & (kk3 == low_k[None, :, :]) & node_ok[None, :, :]  # [K, N, B]
         wval_cl = jnp.sum(jnp.where(sel_k, pend[:, None, :], 0), axis=0)  # [N, B]
+        wtick_cl = (
+            jnp.sum(jnp.where(sel_k, ptick[:, None, :], 0), axis=0) if track else None
+        )
         accepted_k = jnp.any(sel_k, axis=1)  # [K, B]
         cmds_cnt = jnp.sum(accepted_k, axis=0).astype(jnp.int32)  # [B]
         tgt_ld = jnp.max(jnp.where(tgt_oh, leader_id[None, :, :], NIL), axis=1)  # [K, B]
@@ -526,12 +549,18 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         client_dst = jnp.where(
             pend_on, jnp.where(tgt_up & (tgt_ld != NIL), tgt_ld, inp.client_bounce), 0
         )
+        client_tick = jnp.where(pend_on, ptick, 0) if track else s.client_tick
     else:
         client_ok = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive & room & ~noop
         wval_cl = jnp.broadcast_to(inp.client_cmd[None, :], (n, b))
+        # Direct mode accepts on the offer tick: stamp = now + 1 (raft.py).
+        wtick_cl = (
+            jnp.broadcast_to((s.now + 1)[None, :], (n, b)) if track else None
+        )
         cmds_cnt = jnp.any(client_ok, axis=0).astype(jnp.int32)  # offers, not appends
         client_pend = s.client_pend
         client_dst = s.client_dst
+        client_tick = s.client_tick
     do_write = noop | client_ok
     wval = jnp.where(noop, NOOP, wval_cl)  # [N, B]
     # cap matches no slot -> masked-off writes dropped.
@@ -539,6 +568,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     inj_oh = iota((1, cap, 1), 1) == inj_pos[:, None, :]  # [N, CAP, B]
     log_term_arr = jnp.where(inj_oh, term[:, None, :], log_term_arr)
     log_val_arr = jnp.where(inj_oh, wval[:, None, :], log_val_arr)
+    if track:
+        # No-op entries carry stamp 0 (protocol filler, never a client offer).
+        wtick = jnp.where(noop, 0, wtick_cl)  # [N, B]
+        log_tick_arr = jnp.where(inj_oh, wtick[:, None, :], log_tick_arr)
     log_len = log_len + do_write
 
     # ---- phase 7: timers ---------------------------------------------------------
@@ -638,6 +671,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     ship_used = send_append[:, None, :] & (iota((1, e, 1), 1) < n_ship[:, None, :])
     out_ent_term = jnp.where(ship_used, wt, 0)
     out_ent_val = jnp.where(ship_used, wv, 0)
+    if track:
+        wtk = (log_ops.window_rb if comp else log_ops.window_b)(log_tick_arr, ws, e)
+        out_ent_tick = jnp.where(ship_used, wtk, 0)
+    else:
+        out_ent_tick = mb.ent_tick  # zeros, loop-invariant carry component
 
     # Responses [receiver, responder]: the edge plane carries only the response
     # TYPE; payloads (grant target, ack target, match, hint, term) are per
@@ -671,6 +709,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         ent_count=jnp.where(send_append, n_ship, 0),
         ent_term=out_ent_term,
         ent_val=out_ent_val,
+        ent_tick=out_ent_tick,
         # Without compaction the snapshot header is dead weight: pass the zeros
         # through untouched so XLA sees a loop-invariant carry component (raft.py).
         req_base=jnp.where(send_append, base, 0) if comp else mb.req_base,
@@ -715,12 +754,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         base_chk=bchk,
         log_term=log_term_arr,
         log_val=log_val_arr,
+        log_tick=log_tick_arr,
         log_len=log_len,
         clock=clock,
         deadline=deadline,
         heard_clock=heard,
         client_pend=client_pend,
         client_dst=client_dst,
+        client_tick=client_tick,
         lat_frontier=lat_frontier,
         now=s.now + 1,
         mailbox=new_mb,

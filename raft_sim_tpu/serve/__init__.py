@@ -1,0 +1,23 @@
+"""Fleet-as-a-service: the standing-fleet serve subsystem (ISSUE 6).
+
+Three pieces (docs/SERVE.md has the architecture):
+  ingest.py  -- host command sources packed into per-chunk offer planes
+  loop.py    -- the double-buffered served scan + ServeSession driver
+  deltas.py  -- device-side commit-delta extraction (the streaming apply/ack
+                surface replacing the host snapshot-diff poll)
+"""
+
+from raft_sim_tpu.serve.deltas import DeltaStream, extract
+from raft_sim_tpu.serve.ingest import CommandSource, jsonl_commands, pack_chunk
+from raft_sim_tpu.serve.loop import ServeSession, serve_config, simulate_serve
+
+__all__ = [
+    "CommandSource",
+    "DeltaStream",
+    "ServeSession",
+    "extract",
+    "jsonl_commands",
+    "pack_chunk",
+    "serve_config",
+    "simulate_serve",
+]

@@ -1,0 +1,109 @@
+"""Client command ingest: host sources packed into per-chunk offer planes.
+
+The reference accepts arbitrary client commands over a long-lived HTTP server
+(`POST /client-set`, server.clj:8-12, core.clj:151-160). The serve loop's
+equivalent is a `CommandSource` -- any iterator of int32 payloads (a JSONL
+file, stdin, a generator) -- whose values are PACKED into the next chunk's
+offer plane (`pack_chunk`: one [chunk] int32 array, one offered command per
+tick slot, NIL-padded) while the current chunk executes on device
+(serve/loop.py's double buffer).
+
+`pack_chunk` is the single packing helper every offer plane goes through:
+the serve loop, the CI smoke harness, and tests that replay scenario-genome
+client cadences as explicit planes all build their [T] arrays here, so the
+NIL-padding/validation rules cannot fork.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from raft_sim_tpu.types import NIL, NOOP
+
+_INT32_MIN, _INT32_MAX = -(2**31), 2**31 - 1
+
+
+def check_value(value: int) -> int:
+    """Validate one client payload: any int32 except the NIL/NOOP sentinels
+    (-1/-2) -- the SAME rule Session.offer enforces. Values that collide with
+    the old tick encoding (small positive ints) are explicitly legal now:
+    latency rides the offer-tick plane, never the payload."""
+    value = int(value)
+    if value in (NIL, NOOP):
+        raise ValueError(
+            f"client value {value} collides with the NIL/NOOP sentinels "
+            f"({NIL}/{NOOP}); any other int32 is legal"
+        )
+    if not _INT32_MIN <= value <= _INT32_MAX:
+        raise ValueError(f"client value must fit int32, got {value}")
+    return value
+
+
+def pack_chunk(values: list[int], chunk: int) -> np.ndarray:
+    """THE offer-plane packing helper: up to `chunk` validated payloads into a
+    [chunk] int32 plane, one command per tick slot, NIL = no offer that tick."""
+    if len(values) > chunk:
+        raise ValueError(f"{len(values)} values do not fit a {chunk}-tick chunk")
+    plane = np.full((chunk,), NIL, np.int32)
+    for i, v in enumerate(values):
+        plane[i] = check_value(v)
+    return plane
+
+
+def parse_line(raw: str):
+    """One JSONL source line -> payload int or None (blank/comment). Accepts a
+    bare integer or {"value": <int>} (extra keys ignored, so richer command
+    records can share the stream)."""
+    line = raw.strip()
+    if not line or line.startswith("#"):
+        return None
+    doc = json.loads(line)
+    if isinstance(doc, dict):
+        if "value" not in doc:
+            raise ValueError(f"command record without a 'value' key: {line!r}")
+        doc = doc["value"]
+    if isinstance(doc, bool) or not isinstance(doc, int):
+        raise ValueError(f"command value must be an integer, got {line!r}")
+    return doc
+
+
+def jsonl_commands(path: str) -> Iterator[int]:
+    """Payload iterator over a JSONL command file ('-' = stdin): one command
+    per line, bare int or {"value": v}."""
+    fh = sys.stdin if path == "-" else open(path)
+    try:
+        for raw in fh:
+            v = parse_line(raw)
+            if v is not None:
+                yield v
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+
+
+class CommandSource:
+    """Pull-based ingest queue over any payload iterator.
+
+    `next_chunk(chunk)` pulls up to `chunk` commands and packs them into the
+    next chunk's offer plane; `exhausted` flips when the iterator ends (the
+    serve loop then runs its drain chunks so trailing commits still export).
+    """
+
+    def __init__(self, commands: Iterable[int]):
+        self._it = iter(commands)
+        self.exhausted = False
+        self.offered = 0
+
+    def next_chunk(self, chunk: int) -> np.ndarray:
+        values: list[int] = []
+        while len(values) < chunk and not self.exhausted:
+            try:
+                values.append(next(self._it))
+            except StopIteration:
+                self.exhausted = True
+        self.offered += len(values)
+        return pack_chunk(values, chunk)

@@ -254,10 +254,14 @@ def make_inputs(
         else:
             skew = jnp.ones((n,), jnp.int32)
 
-        # Client commands: value = tick at injection + 1 (payload bytes carry
-        # no protocol meaning in the reference either, log.clj:66-67; the +1
-        # keeps 0 free and lets the commit-latency metric recover the offer
-        # tick from the value).
+        # Client commands: value = tick at injection + 1 -- a deterministic,
+        # human-readable payload choice, nothing more. Since the v21 decoupling
+        # the commit-latency metric reads the offer-tick PLANE the kernels
+        # stamp at injection (ClusterState.log_tick), never the value: any
+        # int32 payload is legal (serve/ingest.py check_value), and a served
+        # offer plane replaying this cadence is bit-exact with it
+        # (tests/test_serve.py). Payload bytes carry no protocol meaning in
+        # the reference either (log.clj:66-67).
         if cfg.client_interval > 0:
             client_cmd = jnp.where(now % cfg.client_interval == 0, now + 1, NIL)
         else:

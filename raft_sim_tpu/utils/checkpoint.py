@@ -72,7 +72,15 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      RunMetrics gained multi_leader (split-brain exposure ticks -- the
 #      search's election-safety precursor signal). ClusterState/Mailbox are
 #      unchanged.
-_FORMAT_VERSION = 20
+# v21: payload/latency decoupling (the serve subsystem's enabler) --
+#      ClusterState gained log_tick (the [N, CAP] offer-stamp plane the
+#      commit-latency metric now reads, freeing log_val for arbitrary client
+#      payloads) and client_tick (offer stamps riding the redirect pipeline
+#      slots); Mailbox gained ent_tick (the shared-window stamp plane, so
+#      replication carries the stamps). All three are zeros and loop-invariant
+#      unless cfg.track_offer_ticks (client_interval > 0 or the new
+#      RaftConfig.serve_ingest gate).
+_FORMAT_VERSION = 21
 
 # The single exported source of truth for the on-disk format version
 # (re-exported as raft_sim_tpu.CHECKPOINT_FORMAT_VERSION). Everything that
@@ -88,7 +96,7 @@ FORMAT_VERSION = _FORMAT_VERSION
 # refreshing this pin -- the convention the v2..v19 log always relied on,
 # now machine-checked. Refresh with:
 #     python -c "from raft_sim_tpu.analysis import policy; print(policy.schema_fingerprint())"
-_SCHEMA_FINGERPRINT = (20, "174ef133b42039cb")
+_SCHEMA_FINGERPRINT = (21, "350d7326be89d46b")
 
 
 def _normalize(path: str) -> str:

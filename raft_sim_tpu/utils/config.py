@@ -134,6 +134,15 @@ class RaftConfig:
     # slot first. 1 = the round-4 single-command client.
     client_pipeline: int = 1
 
+    # Standing-fleet serving (raft_sim_tpu/serve). When True, the simulator
+    # expects externally ingested client commands (driver `serve`,
+    # Session.offer) even with client_interval == 0, so the offer-tick plane
+    # (ClusterState.log_tick) and the commit-latency metric stay live for
+    # them. Purely a structural gate: it changes which carry legs the tick
+    # maintains (like pre_vote/compaction), never the protocol semantics --
+    # a serve config with no offers ticks identically to the plain config.
+    serve_ingest: bool = False
+
     # PreVote (Raft thesis 9.6; BEYOND the reference, which has neither
     # pre-vote nor leadership transfer -- SURVEY.md 2.3.12). When True, an
     # expired node becomes a PRECANDIDATE and probes a majority at its
@@ -185,6 +194,16 @@ class RaftConfig:
         # margin >= 2 keeps that client ceiling above the steady-state retained
         # window (CAP - margin), and the margin must not consume the whole ring.
         assert self.compact_margin == 0 or 2 <= self.compact_margin < self.log_capacity
+
+    @property
+    def track_offer_ticks(self) -> bool:
+        """True when the offer-tick plane (ClusterState.log_tick, the
+        Mailbox.ent_tick wire window, and the commit-latency metric) is
+        maintained: any config that can see client commands whose latency
+        should be measured -- a scheduled cadence (client_interval > 0) or a
+        standing serve ingest (serve_ingest). Payload values are arbitrary
+        int32 either way; latency reads ONLY this plane (never values)."""
+        return self.client_interval > 0 or self.serve_ingest
 
     @property
     def compaction(self) -> bool:

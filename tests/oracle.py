@@ -112,6 +112,10 @@ def term_at_ring(log_term: np.ndarray, base: int, base_term: int, index1: int) -
 def oracle_step(cfg, s: dict, inp: dict) -> dict:
     """One tick for one cluster; returns a fresh state dict."""
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
+    # Offer-tick plane active: latency stamps ride log_tick / mailbox ent_tick
+    # beside the (now arbitrary) payload values; inactive configs leave every
+    # tick-plane leaf untouched (mirroring the kernel's passthrough legs).
+    track = cfg.track_offer_ticks
     mb = s["mailbox"]
 
     role = s["role"].copy()
@@ -129,6 +133,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     base_chk = s["base_chk"].copy()
     log_term = s["log_term"].copy()
     log_val = s["log_val"].copy()
+    log_tick = s["log_tick"].copy()
     log_len = s["log_len"].copy()
     deadline = s["deadline"].copy()
     heard_clock = s["heard_clock"].copy()
@@ -283,6 +288,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         # window occur only at masked k >= n_ent positions).
         ent_t = [int(mb["ent_term"][src, min(j + k, e - 1)]) for k in range(e)]
         ent_v = [int(mb["ent_val"][src, min(j + k, e - 1)]) for k in range(e)]
+        ent_tk = [int(mb["ent_tick"][src, min(j + k, e - 1)]) for k in range(e)]
 
         b = int(log_base[d])
         # prev below our base is committed-and-compacted: consistent by leader
@@ -309,6 +315,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for k in range(lo, n_acc):
             log_term[d, (prev_i + k) % cap] = ent_t[k]
             log_val[d, (prev_i + k) % cap] = ent_v[k]
+            if track:
+                # The offer stamp replicates with the entry it tags.
+                log_tick[d, (prev_i + k) % cap] = ent_tk[k]
         log_len[d] = new_len
 
         last_new = min(prev_i + n_acc, new_len)
@@ -468,11 +477,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # ---- phase 6: client injection (ring slot; space = retained window < CAP),
     # redirect routing, and the election-win leader no-op (raft.py phase 6)
     cmd_in = int(inp["client_cmd"])
+    now0 = int(s["now"])  # pre-increment tick: a fresh offer's stamp is now0 + 1
     comp = cfg.compact_margin > 0
     reserve = max(1, cfg.compact_margin // 2)
     K = cfg.client_pipeline
     client_pend = [int(x) for x in np.atleast_1d(s["client_pend"])]
     client_dst = [int(x) for x in np.atleast_1d(s["client_dst"])]
+    client_tick = [int(x) for x in np.atleast_1d(s["client_tick"])]
 
     def noop_at(d):
         return comp and win[d] and int(log_len[d]) - int(log_base[d]) < cap
@@ -481,9 +492,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         retained = int(log_len[d]) - int(log_base[d])
         return retained < (cap - reserve if comp else cap)
 
-    def append(d, value):
+    def append(d, value, stamp):
         log_term[d, log_len[d] % cap] = term[d]
         log_val[d, log_len[d] % cap] = value
+        if track:
+            # Offer stamp beside the payload (no-ops/protocol filler: 0).
+            log_tick[d, log_len[d] % cap] = stamp
         log_len[d] += 1
 
     if cfg.client_redirect:
@@ -492,21 +506,23 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         # node per tick, lowest slot index first.
         pend = list(client_pend)
         tgt = list(client_dst)
+        ptk = list(client_tick)
         if cmd_in != NIL:
             for k in range(K):
                 if pend[k] == NIL:
                     pend[k] = cmd_in
                     tgt[k] = int(inp["client_target"])
+                    ptk[k] = now0 + 1
                     break
         accepted = [False] * K
         for d in range(n):
             if noop_at(d):
-                append(d, NOOP)
+                append(d, NOOP, 0)
                 continue
             here = [k for k in range(K) if pend[k] != NIL and tgt[k] == d]
             if here and role[d] == LEADER and alive[d] and room_at(d):
                 k = min(here)
-                append(d, pend[k])
+                append(d, pend[k], ptk[k])
                 accepted[k] = True
         for k in range(K):
             if pend[k] != NIL and not accepted[k]:
@@ -516,14 +532,18 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 client_dst[k] = (
                     tl if (alive[t] and tl != NIL) else int(inp["client_bounce"][k])
                 )
+                if track:
+                    client_tick[k] = ptk[k]
             else:
                 client_pend[k], client_dst[k] = NIL, 0
+                if track:
+                    client_tick[k] = 0
     else:
         for d in range(n):
             if noop_at(d):
-                append(d, NOOP)
+                append(d, NOOP, 0)
             elif cmd_in != NIL and role[d] == LEADER and alive[d] and room_at(d):
-                append(d, cmd_in)
+                append(d, cmd_in, now0 + 1)
 
     # ---- phase 7: timers
     clock = s["clock"] + np.asarray(inp["skew"], np.int32)
@@ -575,6 +595,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "ent_count": z(n),
         "ent_term": z(n, e),
         "ent_val": z(n, e),
+        "ent_tick": z(n, e),
         "req_base": z(n),
         "req_base_term": z(n),
         "req_base_chk": np.zeros(n, np.uint32),
@@ -633,6 +654,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             for k in range(n_ship):
                 out["ent_term"][src, k] = log_term[src, (ws + k) % cap]
                 out["ent_val"][src, k] = log_val[src, (ws + k) % cap]
+                if track:
+                    out["ent_tick"][src, k] = log_tick[src, (ws + k) % cap]
             for dst in range(n):
                 if dst == src:
                     continue
@@ -664,7 +687,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # measurement state maintained only under client workloads, deduping the
     # latency metric against the highest commit any node ever reached.
     lat_frontier = int(s["lat_frontier"])
-    if cfg.client_interval > 0:
+    if track:
         lat_frontier = max(lat_frontier, int(commit.max()))
 
     return {
@@ -683,12 +706,14 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "base_chk": base_chk,
         "log_term": log_term,
         "log_val": log_val,
+        "log_tick": log_tick,
         "log_len": log_len,
         "clock": clock,
         "deadline": deadline,
         "heard_clock": heard_clock,
         "client_pend": np.asarray(client_pend, np.int32),
         "client_dst": np.asarray(client_dst, np.int32),
+        "client_tick": np.asarray(client_tick, np.int32),
         "lat_frontier": np.int32(lat_frontier),
         "now": np.int32(int(s["now"]) + 1),
         "mailbox": out,

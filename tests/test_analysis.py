@@ -133,9 +133,10 @@ def test_recompile_fork_guard():
     # pre_vote genuinely forks the program: the guard must see it on BOTH the
     # plain scan and the scenario (genome-path) scan ...
     got = jaxpr_audit.check_recompile_forks((("config3", {"pre_vote": True}),))
-    assert [f.rule for f in got] == ["recompile-fork", "recompile-fork"]
+    assert [f.rule for f in got] == ["recompile-fork"] * 3
     assert {f.path for f in got} == {
-        "jaxpr:config3/simulate", "jaxpr:config3/scenario_simulate"
+        "jaxpr:config3/simulate", "jaxpr:config3/scenario_simulate",
+        "jaxpr:config3/serve_simulate",
     }
     # ... while a tuning-only change must not (one standing pair, cheap) --
     # and on the scenario program that includes the fault knobs themselves:
@@ -161,8 +162,8 @@ def test_types_comments_parse_and_hold():
     specs, problems = policy.parse_types_comments()
     assert problems == []
     # Full field coverage: every field of the four structures has a contract.
-    assert len(specs["ClusterState"]) == 23
-    assert len(specs["Mailbox"]) == 21
+    assert len(specs["ClusterState"]) == 25  # v21: +log_tick, +client_tick
+    assert len(specs["Mailbox"]) == 22  # v21: +ent_tick
     assert len(specs["StepInputs"]) == 8
     assert len(specs["StepInfo"]) == 16
     assert ast_lint.check_dtype_comments() == []

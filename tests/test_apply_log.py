@@ -4,6 +4,7 @@ validated against the offered command schedule across compaction boundaries."""
 
 import jax
 import numpy as np
+import pytest
 
 from raft_sim_tpu import RaftConfig
 from raft_sim_tpu.driver import Session
@@ -64,10 +65,12 @@ def test_export_survives_session_offer_and_counts_it(tmp_path):
     assert -50 in sess.apply_writer.values(0)
 
 
+@pytest.mark.slow
 def test_reset_restarts_the_export_stream(tmp_path):
     """Session.reset rebuilds the experiment; an attached writer must restart
     too (truncated files, zeroed frontier) -- a stale frontier would silently
-    drop the new run's early commits (code-review finding)."""
+    drop the new run's early commits (code-review finding). Slow tier (two
+    200-tick runs; the export-correctness tests above stay tier-1)."""
     sess = Session(CFG, batch=1, seed=0)
     sess.attach_apply_log(str(tmp_path), cluster=0)
     sess.run(200, chunk=25)

@@ -12,6 +12,7 @@ watch should have measured is a first-class metric
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from raft_sim_tpu import NIL, RaftConfig
 from raft_sim_tpu.parallel import summarize
@@ -147,10 +148,13 @@ def test_session_offer_reports_committed_under_redirect():
 
 
 def test_session_offer_value_collision_never_false_positives():
-    """A value colliding with an already-committed scheduled command (values
-    encode offer ticks) must not be reported as this offer's commitment: the
-    pre-offer snapshot makes collisions a conservative undercount
-    (code-review finding)."""
+    """A value colliding with an already-committed scheduled command must not
+    be reported as this offer's commitment. Under the delta-stream ack
+    (serve/deltas.py) the watcher's watermark is fast-forwarded past
+    everything committed BEFORE the offer, so at wait=0 the old entry cannot
+    false-positive -- and unlike the superseded snapshot-diff poll (which
+    undercounted this input to 0 forever), a waited offer of the same value
+    does ack: tests/test_serve.py pins that half of the contract."""
     from raft_sim_tpu.driver import Session
 
     sess = Session(RaftConfig(n_nodes=5, client_interval=8), batch=8, seed=0)
@@ -198,11 +202,13 @@ def test_pipeline_accepts_one_slot_per_node_per_tick_lowest_first():
     assert int(info2.cmds_injected) == 1
 
 
+@pytest.mark.slow
 def test_pipeline_no_drop_and_all_commit_end_to_end():
     """Offers beyond one-in-flight are not lost: a K=4 pipeline under a fast
     offer cadence accepts strictly more than the K=1 client on the same
     trajectory seeds, and everything offered-and-accepted commits (0
-    violations)."""
+    violations). Slow tier (two 600-tick sims; the pipeline unit tests above
+    and the oracle-parity pipeline row stay tier-1)."""
     base = dict(
         n_nodes=5, log_capacity=32, compact_margin=8, client_interval=2,
         client_redirect=True,

@@ -100,7 +100,8 @@ def test_golden_pins_every_audited_program():
     want = {
         f"{c}/{p}"
         for c in JA.AUDIT_CONFIGS
-        for p in ("step", "step_b", "simulate", "scenario_simulate")
+        for p in ("step", "step_b", "simulate", "scenario_simulate",
+                  "serve_simulate")
     }
     assert set(_golden()["programs"]) == want
     for key, entry in _golden()["programs"].items():
@@ -116,6 +117,9 @@ def test_golden_pins_every_audited_program():
     # The telemetry soak loop (the documented 10M-tick workflow) holds the
     # same contract: its chunk must donate too, or long runs double-buffer.
     assert _golden()["donation"]["sim.telemetry._chunk_t_donate"] == "donated"
+    # ISSUE-6 acceptance: the standing-fleet serve loop never double-buffers
+    # the fleet -- its chunk's donation status is pinned.
+    assert _golden()["donation"]["serve.loop._serve_chunk"] == "donated"
 
 
 def test_tree_gates_clean_cost_pass():

@@ -177,11 +177,14 @@ def test_kitchen_sink_all_faults_at_once():
     checksum, committed-prefix value log matching) every tick. Safety must hold
     unconditionally; liveness is only required of clusters the fault mix actually
     lets breathe (we assert a majority elects at least once, and that the fleet
-    commits)."""
+    commits). PreVote is ON in this tier (VERDICT weak #3): thesis-9.6 probe
+    rounds now run under the full fault mix too, sharing this tier's one
+    compiled scan program instead of adding another."""
     cfg = RaftConfig(
         n_nodes=5,
         log_capacity=64,
         client_interval=4,
+        pre_vote=True,
         drop_prob=0.3,
         drop_prob_uniform=True,
         clock_skew_prob=0.15,

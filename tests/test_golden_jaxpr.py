@@ -61,6 +61,13 @@ SNAPSHOT_PROGRAMS = (
 PINNED_STEP_LOWERINGS = 8
 PINNED_SCAN_LOWERINGS = 8
 PINNED_SCENARIO_SCAN_LOWERINGS = 8
+# The standing-fleet serve program (serve/loop.py simulate_serve): one program
+# per structurally distinct serve-mode config. Serve variants collapse the
+# scheduled cadence (client_interval -> 0), so presets differing ONLY in their
+# cadence share one serve program (config2's serve variant IS config3's) --
+# which is why this pin sits below the preset count. Command values are traced
+# data: a multi-chunk `driver serve` session compiles nothing after warmup.
+PINNED_SERVE_SCAN_LOWERINGS = 7
 
 
 def _pins():
@@ -73,6 +80,7 @@ def _pins():
         low.get("step", PINNED_STEP_LOWERINGS),
         low.get("scan", PINNED_SCAN_LOWERINGS),
         low.get("scenario_scan", PINNED_SCENARIO_SCAN_LOWERINGS),
+        low.get("serve_scan", PINNED_SERVE_SCAN_LOWERINGS),
     )
 
 
@@ -113,14 +121,18 @@ def test_golden_op_histograms():
 
 
 def test_compile_count_pin():
-    pin_step, pin_scan, pin_scenario = _pins()
+    pin_step, pin_scan, pin_scenario, pin_serve = _pins()
     step_hashes = set()
     scan_hashes = set()
     scenario_hashes = set()
+    serve_hashes = set()
     for name, (cfg, _) in PRESETS.items():
         step_hashes.add(JA.program_hash(JA.step_jaxpr(cfg, batched=True)))
         scan_hashes.add(JA.program_hash(JA.scan_jaxpr(cfg)))
         scenario_hashes.add(JA.program_hash(JA.scenario_scan_jaxpr(cfg)))
+        serve_hashes.add(
+            JA.program_hash(JA.serve_scan_jaxpr(JA.serve_variant(cfg)))
+        )
     assert len(step_hashes) <= pin_step, (
         f"{len(step_hashes)} distinct step_b lowerings across the preset "
         f"matrix (pinned {pin_step}): a config that should share "
@@ -142,6 +154,14 @@ def test_compile_count_pin():
         "structure is the exact recompile-per-sweep-point failure the "
         "scenario engine exists to remove."
     )
+    # The serve program: at most one lowering per structurally distinct
+    # serve-mode config (command values are traced data -- a standing
+    # `driver serve` session must compile NOTHING after warmup).
+    assert len(serve_hashes) <= pin_serve, (
+        f"{len(serve_hashes)} distinct serve_simulate lowerings across the "
+        f"preset matrix (pinned {pin_serve}): a command- or chunk-content-"
+        "dependent structure would recompile the standing fleet mid-session."
+    )
 
 
 def _update():
@@ -151,6 +171,7 @@ def _update():
             "step": PINNED_STEP_LOWERINGS,
             "scan": PINNED_SCAN_LOWERINGS,
             "scenario_scan": PINNED_SCENARIO_SCAN_LOWERINGS,
+            "serve_scan": PINNED_SERVE_SCAN_LOWERINGS,
         },
         "programs": _histograms(),
     }

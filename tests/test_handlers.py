@@ -55,13 +55,16 @@ def base_state(cfg=CFG, far=1000):
 
 
 def with_log(s, node, terms):
-    """Install a log (list of entry terms; values = 100+slot) on one node."""
+    """Install a log (list of entry terms; values = 100+slot) on one node.
+    Entries are stamped as client offers (log_tick = value, the pre-decoupling
+    identity), so hand-built states stay visible to the latency metric."""
     lt = s.log_term.at[node, : len(terms)].set(jnp.asarray(terms, jnp.int32))
-    lv = s.log_val.at[node, : len(terms)].set(
-        100 + jnp.arange(len(terms), dtype=jnp.int32)
-    )
+    vals = 100 + jnp.arange(len(terms), dtype=jnp.int32)
+    lv = s.log_val.at[node, : len(terms)].set(vals)
+    ltk = s.log_tick.at[node, : len(terms)].set(vals)
     return s._replace(
-        log_term=lt, log_val=lv, log_len=s.log_len.at[node].set(len(terms))
+        log_term=lt, log_val=lv, log_tick=ltk,
+        log_len=s.log_len.at[node].set(len(terms)),
     )
 
 

@@ -169,6 +169,24 @@ CONFIGS = [
         id="n5-prevote",  # thesis-9.6 probes under churn: precandidate rounds,
         # per-edge grant bits, promotions, prospective-term non-adoption
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            client_interval=2,
+            pre_vote=True,
+            drop_prob=0.25,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        12,
+        id="n5-prevote-compaction",  # the pre_vote x compaction interaction
+        # (VERDICT weak #3): precandidate probes judged against ring logs whose
+        # last-entry position wraps, election no-ops burning ring reserve while
+        # probes defer the term bump, snapshot catch-up of crashed probers
+    ),
 ]
 
 

@@ -11,6 +11,7 @@ forces a gratuitous re-election on heal.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from raft_sim_tpu import RaftConfig, StepInputs, init_state
 from raft_sim_tpu.models import raft
@@ -135,11 +136,14 @@ def _run(cfg, s, inputs, ticks):
     return s
 
 
+@pytest.mark.slow
 def test_partitioned_node_cannot_depose_a_stable_leader():
     """The headline behavior: isolate one node under a stable leader for a long
     time, then heal. With pre_vote its term never inflates and the leader
     survives the heal; without, the rejoiner's inflated term forces the leader
-    out (term adoption -> step down)."""
+    out (term adoption -> step down). Slow tier (long eager isolate/heal
+    loops both ways; the handler-level probe tests above and the prevote
+    parity/fuzz tiers stay tier-1)."""
     for pre_vote, disruptive in ((True, False), (False, True)):
         cfg = RaftConfig(n_nodes=5, log_capacity=8, pre_vote=pre_vote)
         s = init_state(cfg, jax.random.key(0))

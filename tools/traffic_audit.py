@@ -274,6 +274,7 @@ def _fmt_bytes(b):
 
 def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
            telemetry_ring: int | None = None, scenario_segments: int | None = None,
+           serve: bool | None = None,
            anchors: dict | None = None, anchor_source: str | None = None):
     if anchors is None:
         anchors, anchor_source = roofline_anchor()
@@ -362,6 +363,38 @@ def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
             "telemetry_window_only_padded": wm_pad,
             "telemetry_overhead_frac": tel_pad / pp,
         }
+    if serve is not None and serve:
+        # Serve-mode overhead: the offer-tick plane going live (log_tick +
+        # mb.ent_tick + client_tick + lat_frontier become MOVING carry legs)
+        # priced from the LOWERED serve program -- the same derived table the
+        # gated cost model pins (ISSUE 6: the plane's cost is a number, not
+        # prose). The perf tiers (no client traffic) pay ZERO on their plain
+        # runs: the plane legs are loop-invariant there (analysis/policy.py).
+        from raft_sim_tpu.analysis.jaxpr_audit import serve_scan_jaxpr, serve_variant
+
+        plain_cm = cost_model.carry_model(jaxpr_audit.scan_jaxpr(cfg), batch=batch)
+        serve_cm = cost_model.carry_model(
+            serve_scan_jaxpr(serve_variant(cfg)), batch=batch
+        )
+        plane_rows = [
+            (nm, leg) for nm, leg in serve_cm["legs"].items()
+            if nm in ("log_tick", "mb.ent_tick", "client_tick") and leg["moving"]
+        ]
+        plane_pad = sum(2 * leg["padded"] for _, leg in plane_rows)
+        delta = serve_cm["carry_padded"] - plain_cm["carry_padded"]
+        print(
+            f"serve mode (offer-tick plane live): scan carry "
+            f"{_fmt_bytes(plain_cm['carry_padded'])} -> "
+            f"{_fmt_bytes(serve_cm['carry_padded'])} padded per cluster-tick "
+            f"(+{100 * delta / pp:.1f}% of the packed tick); the plane itself "
+            f"({', '.join(nm for nm, _ in plane_rows)}) costs {_fmt_bytes(plane_pad)}",
+            file=out,
+        )
+        res |= {
+            "serve_carry_padded": serve_cm["carry_padded"],
+            "serve_plane_padded": plane_pad,
+            "serve_overhead_frac": delta / pp if pp else None,
+        }
     if scenario_segments is not None:
         # Scenario-engine overhead: the genome broadcast (S-segment program
         # table, 7 leaves x 4 B per cluster) read each tick by the genome
@@ -406,6 +439,11 @@ def main(argv=None) -> int:
                          "an S-segment program table per cluster "
                          "(raft_sim_tpu/scenario; S=1 prices a plain "
                          "heterogeneous-fleet genome)")
+    ap.add_argument("--serve", action="store_true",
+                    help="also price serve mode: the offer-tick plane "
+                         "(log_tick/ent_tick/client_tick) going live in the "
+                         "standing-fleet program (raft_sim_tpu/serve), "
+                         "derived from the lowered serve scan")
     args = ap.parse_args(argv)
 
     # With --json the human tables go to stderr so stdout is exactly one
@@ -423,6 +461,7 @@ def main(argv=None) -> int:
         results.append(report(name, cfg, batch, args.top, out=table_out,
                               telemetry_ring=args.telemetry_ring,
                               scenario_segments=args.scenario,
+                              serve=args.serve,
                               anchors=anchors, anchor_source=anchor_source))
     if args.json:
         print(json.dumps(results))
