@@ -103,18 +103,21 @@ def _telemetry_window(ticks: int) -> int:
     return ticks
 
 
-def _pin_applies(config_name: str, batch: int, smoke: bool) -> bool:
-    """The pins are priced at the preset's production batch; a --smoke or
-    custom-batch row must not carry a headroom number computed against a
-    different-batch roofline (it would read as ~100x headroom on CPU).
-    `smoke` is checked on its own because a preset whose smoke batch equals
-    its production batch (config1: batch 1 both ways) would otherwise slip
-    through the batch comparison."""
+def _pin_applies(config_name: str, cfg: RaftConfig, batch: int,
+                 smoke: bool) -> bool:
+    """The pins are priced at the preset's production batch AND its exact
+    config; a --smoke row, a custom-batch row, or a config-variant row (e.g.
+    the measurement pass's serve_ingest=True arm, whose carry the pin does
+    not price) must not carry a headroom number computed against a different
+    program's roofline. `smoke` is checked on its own because a preset whose
+    smoke batch equals its production batch (config1: batch 1 both ways)
+    would otherwise slip through the batch comparison."""
     return (not smoke and config_name in PRESETS
-            and batch == PRESETS[config_name][1])
+            and batch == PRESETS[config_name][1]
+            and cfg == PRESETS[config_name][0])
 
 
-def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
+def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 3,
           quality_seeds: int = 3, telemetry_dir: str | None = None,
           config_name: str = "custom", scenario=None,
           smoke: bool = False) -> dict:
@@ -168,13 +171,27 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
     )
 
     seed_base = int(time.time_ns() % ((1 << 31) - 1 - repeats))
-    best = float("inf")
+    walls = []
     for r in range(1, repeats + 1):
         t0 = time.perf_counter()
         final, metrics = sim(seed_base + r)
         # Time to a host copy, not block_until_ready (see module docstring).
         np.asarray(metrics.ticks)
-        best = min(best, time.perf_counter() - t0)
+        walls.append(time.perf_counter() - t0)
+    best = min(walls)
+    # Steady-state stats exclude the FIRST timed repeat: the quality runs
+    # already paid the compile, but repeat 1 still carries dispatch/cache
+    # warmth (and on some stacks a late autotune) -- reconciliation against
+    # the cost-model pins must not be polluted by it (obs/reconcile.py reads
+    # steady_ticks_per_s first). With repeats == 1 there is nothing to
+    # exclude: the single wall is used and repeat_cv is None (unknowable).
+    steady_walls = walls[1:] if len(walls) > 1 else walls
+    steady_mean = float(np.mean(steady_walls))
+    steady_cv = (
+        round(float(np.std(steady_walls) / steady_mean), 4)
+        if len(steady_walls) > 1 and steady_mean > 0
+        else (0.0 if len(steady_walls) > 1 else None)
+    )
 
     s = summarize(q_metrics)  # pooled fixed-seed quality metrics
     if telemetry_dir is not None:
@@ -192,11 +209,22 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
     # stale, regenerate after this round's artifact lands.
     pin = _ROOFLINE_PINS.get(f"{config_name}/simulate", {})
     roof = pin.get("roofline_ticks_per_s")
-    if not _pin_applies(config_name, batch, smoke):
+    if not _pin_applies(config_name, cfg, batch, smoke):
         roof = None
     row = {
+        # Legacy headline: best wall over ALL timed repeats (including the
+        # warmup-adjacent first one) -- the exact definition BENCH_r01-r05
+        # recorded, kept byte-compatible so old artifacts stay diffable; the
+        # "legacy" marker names it so nothing new reads it by accident.
         "cluster_ticks_per_s": round(value, 1),
         "vs_baseline": round(value / NORTH_STAR, 3),
+        "legacy": ["cluster_ticks_per_s", "wall_s", "vs_baseline"],
+        # Steady-state throughput: warmup repeat excluded, mean-based (the
+        # reconciliation input), with per-repeat variance made visible.
+        "steady_ticks_per_s": round(batch * ticks / steady_mean, 1),
+        "repeat_walls_s": [round(w, 4) for w in walls],
+        "repeat_cv": steady_cv,
+        "backend": jax.default_backend(),
         "batch": batch,
         "n_nodes": cfg.n_nodes,
         "ticks": ticks,
@@ -219,10 +247,283 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 2,
         # Marked so cost_model.bench_anchor can reject the row even when the
         # preset's smoke batch equals its production batch (config1).
         row["smoke"] = True
+    if scenario is not None:
+        # Marked HERE, not by the CLI layer: every consumer that must refuse
+        # scenario-path throughput (cost_model.bench_anchor, obs/reconcile's
+        # anchor flag) keys on this field, so a bench() caller that bypasses
+        # main() -- the measurement pass's fault-lattice arm -- must not be
+        # able to produce an unmarked scenario row.
+        row["scenario"] = getattr(scenario, "name", "scenario")
     if roof and scenario is None:
         row["predicted_roofline_ticks_per_s"] = round(roof, 1)
         row["roofline_headroom"] = round(roof / value, 3)
     return row
+
+
+# ---------------------------------------------------------- measurement pass
+
+# Schema tag of the MEASUREMENT_r*.json artifact --measurement-pass writes;
+# tools/metrics_report.py --perf refuses documents it does not recognize.
+MEASUREMENT_SCHEMA = "measurement-pass-v1"
+
+MATRIX_CONFIGS = (
+    "config1", "config2", "config3", "config4", "config4c", "config5",
+    "config6", "config6r",
+)
+
+
+def _matrix_sizing(name: str, smoke: bool) -> tuple[int, int]:
+    """(batch, ticks) for one matrix row under the standard sizing rules."""
+    _, preset_batch = PRESETS[name]
+    batch = SMOKE_BATCH.get(name, min(preset_batch, 256)) if smoke else preset_batch
+    ticks = (
+        SMOKE_TICKS[name]
+        if smoke and name in SMOKE_TICKS
+        else MATRIX_TICKS.get(name, 300)
+    )
+    return batch, ticks
+
+
+def _next_measurement_path() -> str:
+    """MEASUREMENT_r<N+1>.json where N is the highest round any BENCH_r* or
+    MEASUREMENT_r* artifact in the repo root records."""
+    import re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    rounds = [0]
+    for f in os.listdir(root):
+        m = re.fullmatch(r"(?:BENCH|MEASUREMENT)_r(\d+)\.json", f)
+        if m:
+            rounds.append(int(m.group(1)))
+    return os.path.join(root, f"MEASUREMENT_r{max(rounds) + 1:02d}.json")
+
+
+def _bench_trajectory() -> tuple[list[dict], list[str]]:
+    """(per-artifact throughput history, notes): one entry per BENCH_r*.json
+    in round order, carrying each recoverable row's legacy headline -- the
+    BENCH_r01 -> now line the measurement report draws, with the unmeasured
+    tail (rounds after the newest artifact) called out."""
+    import re
+
+    from raft_sim_tpu.analysis import cost_model
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    entries, notes = [], []
+    paths = sorted(
+        (f for f in os.listdir(root) if re.fullmatch(r"BENCH_r\d+\.json", f)),
+        key=lambda p: int(re.search(r"r(\d+)", p).group(1)),
+    )
+    for name in paths:
+        try:
+            with open(os.path.join(root, name)) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as ex:
+            notes.append(f"{name}: unreadable ({ex}); skipped")
+            continue
+        rows = cost_model.bench_matrix(doc)
+        entries.append({
+            "source": name,
+            "round": int(re.search(r"r(\d+)", name).group(1)),
+            "ticks_per_s": {
+                k: v.get("cluster_ticks_per_s")
+                for k, v in sorted(rows.items())
+                if isinstance(v, dict)
+            },
+        })
+    if entries:
+        newest = entries[-1]["round"]
+        notes.append(
+            f"newest hardware artifact is round {newest}: every perf claim "
+            f"since (bit-packing, fault lattice, serve offer-plane, ...) was "
+            "priced by the gated cost model but UNMEASURED on hardware until "
+            "a chip measurement pass lands"
+        )
+    else:
+        notes.append("no BENCH_r*.json artifacts found: no trajectory to draw")
+    return entries, notes
+
+
+def _ab_pair(label: str, off_row: dict, on_row: dict, notes: list[str]) -> dict:
+    """One A/B arm: both rows plus the steady-state THROUGHPUT ratio
+    on/off -- < 1 means the feature costs throughput (e.g. the fault
+    lattice's documented +66% CPU wall shows up as ~0.6 here), 1.0 = free,
+    > 1 = the feature measured faster (run variance or a real win)."""
+    off_v = off_row.get("steady_ticks_per_s") or off_row.get("cluster_ticks_per_s")
+    on_v = on_row.get("steady_ticks_per_s") or on_row.get("cluster_ticks_per_s")
+    return {
+        "label": label,
+        "off": off_row,
+        "on": on_row,
+        "on_over_off_ticks_per_s": (
+            round(on_v / off_v, 4) if on_v and off_v else None
+        ),
+        "notes": notes,
+    }
+
+
+def measurement_pass(args) -> int:
+    """The owed measurement pass as ONE command (ISSUE 8 / ROADMAP item 1):
+    the standing matrix plus the three unpriced deltas, reconciled against
+    the gated cost-model pins, written as a schema'd MEASUREMENT_r*.json.
+
+    The three A/Bs:
+      bitpack_vs_r05     measured-now vs the archived BENCH_r05 rows -- bit-
+                         packing is STRUCTURAL since checkpoint v18 (there is
+                         no dense kernel to toggle back to), so the A/B is
+                         longitudinal against the last pre-packing chip
+                         artifact; cross-backend ratios are refused.
+      fault_lattice      the same preset through the plain input path vs the
+                         scenario path under its own config's homogeneous
+                         genome (bit-exact trajectories; prices the always-
+                         traced fault lattice -- the +66%-on-CPU delta
+                         docs/SCENARIOS.md expects to compress on chip).
+      serve_offer_plane  the preset vs serve_ingest=True (offer-tick plane
+                         legs live but no traffic) -- prices the serve-mode
+                         carry traffic_audit --serve projects.
+
+    On a CPU image the pass auto-shrinks to --smoke sizing (CPU rows can
+    never anchor anyway -- reconciliation marks every row non-anchor);
+    --full forces production sizing on any backend.
+    """
+    backend = jax.default_backend()
+    smoke = args.smoke or (backend == "cpu" and not args.full)
+    configs = (
+        [c.strip() for c in args.configs.split(",") if c.strip()]
+        if args.configs
+        else list(MATRIX_CONFIGS)
+    )
+    for c in configs:
+        if c not in PRESETS:
+            raise SystemExit(f"--configs: unknown preset {c!r}")
+    ab_preset = args.ab_preset
+    if ab_preset not in PRESETS:
+        raise SystemExit(f"--ab-preset: unknown preset {ab_preset!r}")
+
+    matrix = {}
+    for name in configs:
+        batch, ticks = _matrix_sizing(name, smoke)
+        print(f"measurement {name}: batch={batch} ticks={ticks}...", file=sys.stderr)
+        matrix[name] = bench(
+            PRESETS[name][0], batch, ticks, args.repeats,
+            config_name=name, smoke=smoke,
+        )
+
+    # --- the three unpriced A/Bs ------------------------------------------
+    import dataclasses as _dc
+    from types import SimpleNamespace
+
+    from raft_sim_tpu.scenario import genome as genome_mod
+
+    ab_cfg = PRESETS[ab_preset][0]
+    ab_batch, ab_ticks = _matrix_sizing(ab_preset, smoke)
+    if ab_preset in matrix:
+        plain = matrix[ab_preset]
+    else:
+        print(f"measurement A/B baseline {ab_preset}...", file=sys.stderr)
+        plain = bench(ab_cfg, ab_batch, ab_ticks, args.repeats,
+                      config_name=ab_preset, smoke=smoke)
+
+    print(f"measurement A/B fault lattice ({ab_preset})...", file=sys.stderr)
+    lattice = bench(
+        ab_cfg, ab_batch, ab_ticks, args.repeats, config_name=ab_preset,
+        smoke=smoke,
+        scenario=SimpleNamespace(
+            genome=genome_mod.from_config(ab_cfg), seg_len=1,
+            name="homogeneous-from-config",
+        ),
+    )
+    print(f"measurement A/B serve offer-plane ({ab_preset})...", file=sys.stderr)
+    serve_on = bench(
+        _dc.replace(ab_cfg, serve_ingest=True), ab_batch, ab_ticks,
+        args.repeats, config_name=ab_preset, smoke=smoke,
+    )
+    # Not the preset's config: say so on the row itself (bench() already
+    # refuses to attach the plain preset's roofline pin to it).
+    serve_on["config_variant"] = "serve_ingest=True"
+
+    r05_notes = []
+    bitpack = {"label": "bitpack_vs_r05", "r05": {}, "measured": {},
+               "measured_over_r05": {}, "notes": r05_notes}
+    r05_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r05.json")
+    if os.path.isfile(r05_path):
+        from raft_sim_tpu.analysis import cost_model
+
+        with open(r05_path) as f:
+            r05_rows = cost_model.bench_matrix(json.load(f))
+        for name in ("config3", "config4", "config5"):
+            old = (r05_rows.get(name) or {}).get("cluster_ticks_per_s")
+            new = (matrix.get(name) or {}).get("steady_ticks_per_s")
+            bitpack["r05"][name] = old
+            bitpack["measured"][name] = new
+            if old and new and backend != "cpu" and not smoke:
+                bitpack["measured_over_r05"][name] = round(new / old, 4)
+        if backend == "cpu" or smoke:
+            r05_notes.append(
+                "BENCH_r05 rows were measured on chip at production sizing; "
+                f"this pass ran backend={backend} smoke={smoke}, so no ratio "
+                "is computed -- the bit-packing delta still awaits a chip "
+                "session"
+            )
+        r05_notes.append(
+            "bit-packing is structural since checkpoint v18: this A/B is "
+            "longitudinal (now vs the last pre-packing artifact), not a "
+            "runtime toggle"
+        )
+    else:
+        r05_notes.append("BENCH_r05.json not found: no pre-packing baseline")
+
+    from raft_sim_tpu.obs import reconcile_matrix
+
+    reconciliation = reconcile_matrix({"matrix": matrix},
+                                      default_backend=backend)
+    trajectory, traj_notes = _bench_trajectory()
+
+    doc = {
+        "schema": MEASUREMENT_SCHEMA,
+        "created_unix": int(time.time()),
+        "backend": backend,
+        "jax_version": jax.__version__,
+        "smoke": smoke,
+        "repeats": args.repeats,
+        "matrix": matrix,
+        "ab": {
+            "bitpack_vs_r05": bitpack,
+            "fault_lattice": _ab_pair(
+                f"{ab_preset}: plain vs scenario-path homogeneous genome",
+                plain, lattice,
+                ["trajectories are bit-exact across the two arms "
+                 "(tests/test_scenario.py pins the homogeneous-genome "
+                 "equivalence); the ratio prices the always-traced lattice"],
+            ),
+            "serve_offer_plane": _ab_pair(
+                f"{ab_preset}: plain vs serve_ingest=True (plane legs live, "
+                "no offered traffic)",
+                plain, serve_on,
+                ["prices the v21 offer-tick plane carry the serve mode pays "
+                 "(traffic_audit --serve has the static projection)"],
+            ),
+        },
+        "reconciliation": reconciliation,
+        "trajectory": trajectory,
+        "notes": traj_notes,
+    }
+    out_path = args.out or _next_measurement_path()
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    anchored = ", ".join(reconciliation["anchor_eligible"]) or (
+        "NONE (this artifact cannot rebase the roofline)"
+    )
+    per_cfg = " ".join(
+        f"{n}={row.get('steady_ticks_per_s', 0):g}" for n, row in matrix.items()
+    )
+    print(
+        f"measurement pass [{backend}{' smoke' if smoke else ''}]: {per_cfg} | "
+        f"anchor-eligible rows: {anchored} | render: "
+        f"python tools/metrics_report.py --perf {out_path}"
+    )
+    return 0
 
 
 def main() -> None:
@@ -231,7 +532,9 @@ def main() -> None:
                     help="bench one config instead of the 3/4/5 matrix")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--ticks", type=int, default=None)
-    ap.add_argument("--repeats", type=int, default=2)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="timed repeats per row; the first is the warmup "
+                         "repeat, excluded from steady_ticks_per_s (default 3)")
     ap.add_argument("--smoke", action="store_true",
                     help="CPU-sized shrink (small batches) of the same matrix")
     ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
@@ -242,6 +545,24 @@ def main() -> None:
                     help="run the benched config(s) through the scenario-"
                          "engine input path under this nemesis program "
                          "(prices the genome-table reads; requires --preset)")
+    ap.add_argument("--measurement-pass", action="store_true",
+                    help="the owed one-command measurement pass (docs/PERF.md "
+                         "checklist): standing matrix + the three unpriced "
+                         "A/Bs (bit-packing vs r05, fault lattice, serve "
+                         "offer-plane) + reconciliation vs the cost-model "
+                         "pins, written as MEASUREMENT_r*.json (--out "
+                         "overrides the path). Auto-shrinks to smoke sizing "
+                         "on CPU; CPU rows are marked non-anchor either way")
+    ap.add_argument("--full", action="store_true",
+                    help="with --measurement-pass: force production sizing "
+                         "even on a CPU backend")
+    ap.add_argument("--configs", default=None, metavar="A,B,...",
+                    help="with --measurement-pass: matrix subset (default: "
+                         "all standing rows)")
+    ap.add_argument("--ab-preset", default="config3", metavar="NAME",
+                    help="with --measurement-pass: the preset the fault-"
+                         "lattice and serve-plane A/Bs run on (default "
+                         "config3, the north-star workload)")
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="write the FULL matrix JSON to PATH and print only a "
                          "short headline line (north-star ratio + per-config "
@@ -251,6 +572,13 @@ def main() -> None:
                          "document cost_model.bench_anchor reads (save it as "
                          "BENCH_r<N>.json to anchor the roofline)")
     args = ap.parse_args()
+
+    if args.measurement_pass:
+        if args.preset or args.scenario or args.batch or args.ticks:
+            ap.error("--measurement-pass runs the standard matrix sizing; it "
+                     "is exclusive with --preset/--scenario/--batch/--ticks "
+                     "(use --configs/--ab-preset/--full to steer it)")
+        sys.exit(measurement_pass(args))
 
     scenario = None
     if args.scenario:
@@ -288,8 +616,6 @@ def main() -> None:
         matrix[name] = bench(cfg, batch, ticks, args.repeats,
                              telemetry_dir=args.telemetry_dir, config_name=name,
                              scenario=scenario, smoke=args.smoke)
-        if scenario is not None:
-            matrix[name]["scenario"] = scenario.name
 
     # The headline is the north-star workload (config3) whenever it ran; benching a
     # different single preset labels itself via "workload" so vs_baseline is never

@@ -180,6 +180,18 @@ def bench_anchor(root: str | None = None):
                 "anchor"
             )
             continue
+        # A row measured on a CPU backend can never rebase the roofline: the
+        # pins project chip HBM rates, and a CPU measurement pass (bench
+        # >= r06 records `backend` per row; obs/reconcile.py marks such rows
+        # non-anchor for the same reason) would silently rebase the implied
+        # rate onto host-memory throughput. Rows with no backend field
+        # (BENCH_r01-r05) are kept: all were recorded on the real chip.
+        if v.get("backend") == "cpu":
+            notes.append(
+                f"{newest}: {k} row measured on the cpu backend: ignored "
+                "for the anchor"
+            )
+            continue
         # A row measured on the scenario path (bench --scenario) prices the
         # genome input lattice, not the plain run loop the roofline
         # projects -- bench itself refuses to attach headroom to such rows.

@@ -83,6 +83,7 @@ class Session:
         self.telemetry = None  # TelemetrySink (attach_telemetry)
         self._tel_rec = None  # flight-recorder carry (batch-minor)
         self._deltas = None  # serve.DeltaStream (offer's commit-ack watcher)
+        self.perf = None  # obs.ChunkTimer (attach_perf)
         self.reset()
 
     def reset(self) -> None:
@@ -106,6 +107,10 @@ class Session:
                 window=self.telemetry.window,
                 ring=self.telemetry.ring,
             )
+        # A rebuilt experiment gets a fresh perf stream too (the re-attach
+        # above already truncated the sink's perf.jsonl).
+        if self.perf is not None:
+            self.attach_perf(warmup_chunks=self.perf.warmup_chunks)
 
     def _apply_sharding(self) -> None:
         if self.devices is None:
@@ -166,6 +171,21 @@ class Session:
             telemetry.init_recorder(self.cfg, ring, self.batch) if ring else None
         )
 
+    def attach_perf(self, warmup_chunks: int | None = None) -> None:
+        """Arm per-chunk runtime attribution (obs.ChunkTimer): run() streams
+        perf.jsonl rows into the attached telemetry sink (or keeps them on
+        `self.perf.rows` with no sink) -- wall time split device-vs-host,
+        warmup vs steady state, device memory occupancy, and the jit-cache
+        recompile watchdog. Purely host-side: trajectories, lowerings, and
+        compile counts are untouched (docs/OBSERVABILITY.md, "Runtime
+        perf")."""
+        from raft_sim_tpu.obs import ChunkTimer
+
+        kwargs = {} if warmup_chunks is None else {"warmup_chunks": warmup_chunks}
+        self.perf = ChunkTimer(
+            label="run", batch=self.batch, sink=self.telemetry, **kwargs
+        )
+
     def run(self, n_ticks: int, chunk: int = 4096, progress: bool = False) -> None:
         def progress_line(done, metrics):
             if progress:
@@ -185,7 +205,7 @@ class Session:
             self.state, m, self._tel_rec = telemetry.run_chunked_telemetry(
                 self.cfg, self.state, self.keys, n_ticks,
                 window=self.telemetry.window, recorder=self._tel_rec,
-                chunk=chunk, callback=cb_t,
+                chunk=chunk, callback=cb_t, perf=self.perf,
             )
             self.metrics = chunked.merge_metrics(self.metrics, m)
             return
@@ -197,7 +217,8 @@ class Session:
             return False
 
         self.state, m = chunked.run_chunked(
-            self.cfg, self.state, self.keys, n_ticks, chunk=chunk, callback=cb
+            self.cfg, self.state, self.keys, n_ticks, chunk=chunk, callback=cb,
+            perf=self.perf,
         )
         self.metrics = chunked.merge_metrics(self.metrics, m)
 
@@ -358,6 +379,7 @@ class Session:
         self.telemetry = None
         self._tel_rec = None
         self._deltas = None
+        self.perf = None
         self.cfg = cfg
         self.batch = state.role.shape[0]
         self.seed = seed
@@ -387,6 +409,17 @@ def _offer_tick(cfg: RaftConfig, state, keys, metrics, value):
     s2, m2, _ = scan.tick_batch_minor(cfg, s_t, keys, m_t, client_cmd=value)
     metrics = raft_batched.from_batch_minor(m2)
     return raft_batched.from_batch_minor(s2), metrics, metrics.total_cmds - before
+
+
+def _profile_ctx(path: str | None):
+    """The --profile capture context, shared by run/serve/scenario-search:
+    a jax.profiler perfetto trace into `path`, or a no-op without one.
+    Capture is bit-exact vs no capture (tier-1 pinned, tests/test_obs.py)."""
+    import contextlib
+
+    if not path:
+        return contextlib.nullcontext()
+    return jax.profiler.trace(path, create_perfetto_trace=True)
 
 
 _FLAG_TYPES = {"int": int, "float": float}
@@ -532,7 +565,8 @@ def _scenario_search(args, ap) -> int:
         seed=args.seed if args.seed is not None else 0,
     )
     try:
-        res = search_mod.search(cfg, spec)
+        with _profile_ctx(args.profile):
+            res = search_mod.search(cfg, spec)
     except ValueError as ex:
         ap.error(str(ex))
     doc = {
@@ -611,11 +645,16 @@ def _serve(args, ap) -> int:
             args.sink, cfg, seed=args.seed or 0, batch=batch,
             window=args.window, ring=0, source="serve",
         )
+    perf = None
+    if args.perf:
+        from raft_sim_tpu.obs import ChunkTimer
+
+        perf = ChunkTimer(label="serve", batch=batch, sink=sink)
     try:
         sess = ServeSession(
             cfg, batch=batch, seed=args.seed or 0, chunk=args.chunk,
             window=args.window, delta_depth=args.delta_depth, sink=sink,
-            warmup_ticks=args.warmup,
+            warmup_ticks=args.warmup, perf=perf,
         )
     except ValueError as ex:
         ap.error(str(ex))
@@ -631,10 +670,11 @@ def _serve(args, ap) -> int:
             )
 
     try:
-        stats = sess.serve(
-            source, chunks=args.chunks, drain_chunks=args.drain_chunks,
-            progress=progress,
-        )
+        with _profile_ctx(args.profile):
+            stats = sess.serve(
+                source, chunks=args.chunks, drain_chunks=args.drain_chunks,
+                progress=progress,
+            )
     except ValueError as ex:
         ap.error(str(ex))
     out = summarize(sess.metrics)._asdict()
@@ -696,6 +736,14 @@ def main(argv=None) -> int:
                        help="flight-recorder depth: last K ticks of StepInfo "
                             "per cluster, frozen at the first violation "
                             "(0 disables; default 32)")
+    run_p.add_argument("--perf", action="store_true",
+                       help="per-chunk runtime attribution (obs.ChunkTimer): "
+                            "device-vs-host wall split, warmup vs steady "
+                            "state, memory occupancy, jit-cache recompile "
+                            "watchdog; streams perf.jsonl into "
+                            "--telemetry-dir when given, and prints the "
+                            "steady-state rollup either way. Host-side only: "
+                            "trajectories and lowerings are untouched")
     _add_config_flags(run_p)
 
     sub.add_parser("presets", help="list the BASELINE config presets")
@@ -739,6 +787,16 @@ def main(argv=None) -> int:
                               "telemetry sink schema")
     serve_p.add_argument("--backend", default="auto", metavar="NAME")
     serve_p.add_argument("--progress", action="store_true")
+    serve_p.add_argument("--perf", action="store_true",
+                         help="per-chunk runtime attribution of the serving "
+                              "loop (dispatch / ingest-pack host gap / "
+                              "device wait; jit-cache watchdog); streams "
+                              "perf.jsonl into --sink when given")
+    serve_p.add_argument("--profile", metavar="DIR", default=None,
+                         help="capture a jax.profiler trace of the serving "
+                              "session into DIR (view with tensorboard/"
+                              "xprof); capture is bit-exact vs no capture "
+                              "(tier-1 pinned)")
     _add_config_flags(serve_p)
 
     sc = sub.add_parser(
@@ -788,6 +846,10 @@ def main(argv=None) -> int:
     ssearch.add_argument("--out", metavar="FILE", default=None,
                          help="write the first violating hit (replayable; "
                               "feeds `scenario shrink --hit`)")
+    ssearch.add_argument("--profile", metavar="DIR", default=None,
+                         help="capture a jax.profiler trace of the hunt into "
+                              "DIR (view with tensorboard/xprof); capture is "
+                              "bit-exact vs no capture (tier-1 pinned)")
     _add_config_flags(ssearch)
 
     sshrink = ssub.add_parser(
@@ -858,10 +920,11 @@ def main(argv=None) -> int:
             ap.error(str(ex))
 
     if args.trace_ticks or args.trace_events:
-        if args.save or args.profile or args.apply_log or args.telemetry_dir:
-            ap.error("--save/--profile/--apply-log/--telemetry-dir have no "
-                     "effect with --trace-ticks/--trace-events (tracing does "
-                     "not advance the session)")
+        if (args.save or args.profile or args.apply_log or args.telemetry_dir
+                or args.perf):
+            ap.error("--save/--profile/--apply-log/--telemetry-dir/--perf "
+                     "have no effect with --trace-ticks/--trace-events "
+                     "(tracing does not advance the session)")
         n = args.trace_ticks or args.ticks
         infos, states = sess.trace(n, cluster=args.trace_cluster)
         if args.trace_events:
@@ -888,15 +951,14 @@ def main(argv=None) -> int:
         except ValueError as ex:
             ap.error(str(ex))
 
-    import contextlib
+    if args.perf:
+        # After attach_telemetry so perf.jsonl streams into the same sink
+        # directory; without --telemetry-dir the rows stay in memory and
+        # only the steady-state rollup is printed.
+        sess.attach_perf()
 
-    prof = (
-        jax.profiler.trace(args.profile, create_perfetto_trace=True)
-        if args.profile
-        else contextlib.nullcontext()
-    )
     t0 = time.perf_counter()
-    with prof:
+    with _profile_ctx(args.profile):
         sess.run(args.ticks, chunk=args.chunk, progress=args.progress)
         # Time to the host-side rollup, not block_until_ready: this TPU stack's
         # block can return before execution finishes (see bench.py docstring);
@@ -905,6 +967,10 @@ def main(argv=None) -> int:
     dt = time.perf_counter() - t0
     out["wall_s"] = round(dt, 3)
     out["cluster_ticks_per_s"] = round(sess.batch * args.ticks / dt, 1)
+    if args.perf:
+        # Steady-state attribution rollup + the recompile-watchdog finding
+        # (finish() prints it to stderr if a steady-state chunk compiled).
+        out["perf"] = sess.perf.finish()
     print(json.dumps(out))
 
     if args.telemetry_dir:

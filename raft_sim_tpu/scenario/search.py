@@ -163,10 +163,17 @@ class SearchResult:
         return json.dumps(dataclasses.asdict(self))
 
 
-def search(cfg: RaftConfig, spec: SearchSpec | None = None) -> SearchResult:
+def search(cfg: RaftConfig, spec: SearchSpec | None = None,
+           perf=None) -> SearchResult:
     """Run the cross-entropy hunt against `cfg` (pass a mutation.py config to
     hunt a weakened kernel). Returns the full generation log and, if any
-    cluster tripped an on-device invariant, the replayable hit."""
+    cluster tripped an on-device invariant, the replayable hit.
+
+    `perf` (an obs.ChunkTimer) attributes each GENERATION (the search's
+    "chunk": one simulate_windowed device call): dispatch vs device wait vs
+    the host-side decode/CE-update gap, with the windowed program's jit
+    cache sampled per generation -- fault genomes are traced data, so a
+    cache that grows after generation 0 is the recompile watchdog firing."""
     spec = spec or SearchSpec()
     knobs = spec.knobs or default_knobs(cfg)
     if spec.ticks % spec.window:
@@ -179,6 +186,8 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None) -> SearchResult:
     gens: list[dict] = []
     hit: dict | None = None
     best_x, best_fit = None, -np.inf
+    if perf is not None:
+        perf.add_probe("telemetry.simulate_windowed", telemetry.simulate_windowed)
 
     for gen in range(spec.generations):
         xs = np.clip(
@@ -190,12 +199,20 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None) -> SearchResult:
         g = genome_mod.stack_rows(rows)  # [B, 1] leaves
         genome_mod.validate(cfg, g)
         sim_seed = spec.seed + SEED_STRIDE * gen
+        if perf is not None:
+            perf.begin(spec.ticks)
         _, metrics, records, _ = telemetry.simulate_windowed(
             cfg, sim_seed, spec.population, spec.ticks, spec.window,
             genome=g,
         )
         import jax
 
+        if perf is not None:
+            # The sync on the small metrics leaf is the device wait; genome
+            # decode (pre-begin) and the fitness/CE update below land in the
+            # adjacent rows' gap_s -- host-attributed either way.
+            perf.dispatched()
+            perf.end(sync=lambda: np.asarray(metrics.ticks))
         metrics = jax.device_get(metrics)
         records = jax.device_get(records)
         fit = fitness_from_records(records, metrics)

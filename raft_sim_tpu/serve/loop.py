@@ -140,6 +140,7 @@ class ServeSession:
         delta_depth: int = 64,
         sink=None,
         warmup_ticks: int = 0,
+        perf=None,
     ):
         if chunk % window:
             raise ValueError(f"chunk {chunk} must divide by window {window}")
@@ -149,6 +150,25 @@ class ServeSession:
         self.chunk = chunk
         self.window = window
         self.sink = sink
+        # Per-chunk runtime attribution (obs.ChunkTimer): dispatch in
+        # _dispatch, ingest packing as the host gap, the _collect device_get
+        # as the device wait -- the double buffer's natural phase boundaries,
+        # so serving pays NO extra sync for attribution. The serve chunk's
+        # jit cache is sampled every boundary (the flat-cache discipline
+        # tests/test_serve.py pins, now a streamed watchdog counter too).
+        self.perf = perf
+        if perf is not None:
+            perf.add_probe("serve._serve_chunk", _serve_chunk)
+            if warmup_ticks:
+                # Warmup chunks (leader election before the first offer) are
+                # compile + convergence time, never steady serving -- and the
+                # FIRST serving chunk after them pays the one-time
+                # donated-carry respecialization (timer docstring), so it is
+                # excluded too.
+                perf.warmup_chunks = max(
+                    perf.warmup_chunks,
+                    self._round_up(warmup_ticks) // chunk + 1,
+                )
         if sink is not None:
             # The session owns the sink directory's delta stream (the sink
             # itself owns manifest/windows/summary): truncate any stale file
@@ -189,10 +209,14 @@ class ServeSession:
     def _dispatch(self, cmds_np: np.ndarray):
         """Issue one chunk (async under jax dispatch); the caller packs the
         NEXT chunk while this one runs."""
+        if self.perf is not None:
+            self.perf.begin(int(cmds_np.shape[0]))
         cmds = jnp.asarray(cmds_np, jnp.int32)
         self.state, self._m_pending, self._recs_pending = _serve_chunk(
             self.cfg, self.state, self.keys, cmds, self.window
         )
+        if self.perf is not None:
+            self.perf.dispatched()
         self.chunks_done += 1
         self.ticks_done += int(cmds_np.shape[0])
 
@@ -200,6 +224,12 @@ class ServeSession:
         """Merge the dispatched chunk's outputs and stream them out (the
         device_get here is the synchronization point of the double buffer)."""
         self.metrics = merge_metrics(self.metrics, self._m_pending)
+        if self.perf is not None:
+            # The ingest packing between _dispatch and here was the host gap;
+            # the sync on this chunk's metric leaf is the device wait. The
+            # export below (sink writes, delta drain) lands in the NEXT row's
+            # gap_s -- still host-attributed, never device.
+            self.perf.end(sync=lambda: np.asarray(self._m_pending.ticks))
         recs = jax.device_get(self._recs_pending)
         if self.sink is not None:
             self.sink.append_windows(recs)
@@ -251,6 +281,9 @@ class ServeSession:
         stats = self.stats()
         stats["wall_s"] = round(time.perf_counter() - t0, 3)
         stats["offered"] = source.offered
+        if self.perf is not None:
+            # Steady-state rollup + the recompile-watchdog finding (stderr).
+            stats["perf"] = self.perf.finish()
         if self.sink is not None:
             from raft_sim_tpu.parallel import summarize
 

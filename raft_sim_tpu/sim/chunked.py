@@ -17,6 +17,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_sim_tpu.sim import scan
 from raft_sim_tpu.types import ClusterState
@@ -88,6 +89,7 @@ def run_chunked(
     callback: Callable[[int, ClusterState, scan.RunMetrics], bool] | None = None,
     genome=None,
     seg_len: int = 1,
+    perf=None,
 ):
     """Scan a batched state forward `n_ticks` in jitted chunks.
 
@@ -104,16 +106,35 @@ def run_chunked(
     `state` captured inside `callback` is only valid until the callback
     returns -- copy (`jax.device_get`) anything a callback needs to keep, as
     the checkpoint/apply-log consumers already do.
+
+    `perf` (an obs.ChunkTimer) records per-chunk runtime attribution to
+    perf.jsonl: each chunk is synced to a host copy of its small metrics leaf
+    (device-wait timing; serializes the dispatch pipelining the loop would
+    otherwise overlap -- docs/OBSERVABILITY.md "Runtime perf") and the chunk
+    program's jit cache is sampled as the recompile watchdog. None (the
+    default) leaves the loop byte-identical to pre-perf behaviour.
     """
     batch = state.role.shape[0]
     metrics = scan.init_metrics_batch(batch)
     done = 0
     state = _own_copy(state)
+    if perf is not None:
+        perf.add_probe("chunked._chunk_donate", _chunk_donate)
     while done < n_ticks:
         n = min(chunk, n_ticks - done)
+        if perf is not None:
+            perf.begin(n)
         state, m = _chunk_donate(cfg, state, keys, n, genome, seg_len)
+        if perf is not None:
+            perf.dispatched()
         metrics = merge_metrics(metrics, m)
         done += n
-        if callback is not None and callback(done, state, metrics):
+        # Callback host work (export, checkpointing) is part of the chunk's
+        # host gap; the timer closes AFTER it, syncing on this chunk's own
+        # metric leaf.
+        stop = callback is not None and callback(done, state, metrics)
+        if perf is not None:
+            perf.end(sync=lambda: np.asarray(m.ticks))
+        if stop:
             break
     return state, metrics

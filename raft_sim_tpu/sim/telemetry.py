@@ -255,10 +255,15 @@ def run_chunked_telemetry(
     callback=None,
     genome=None,
     seg_len: int = 1,
+    perf=None,
 ):
     """Long-horizon telemetry runs: the `chunked.run_chunked` analogue with
     window records offloaded to the host between chunks (so a 10M-tick soak
     holds at most chunk/window records on device at once).
+
+    `perf` (an obs.ChunkTimer) attributes each chunk's wall time and samples
+    the soak program's jit cache at chunk boundaries (recompile watchdog),
+    exactly like `chunked.run_chunked` -- see docs/OBSERVABILITY.md.
 
     Chunks are rounded to whole windows; a final REMAINDER window shorter than
     `window` is emitted if n_ticks does not divide (records are
@@ -278,6 +283,8 @@ def run_chunked_telemetry(
     metrics = scan.init_metrics_batch(batch)
     done = 0
     state = _own_copy(state)
+    if perf is not None:
+        perf.add_probe("telemetry._chunk_t_donate", _chunk_t_donate)
     while done < n_ticks:
         left = n_ticks - done
         if left >= window:
@@ -285,12 +292,21 @@ def run_chunked_telemetry(
             w = window
         else:
             n = w = left  # remainder: one final short window
+        if perf is not None:
+            perf.begin(n)
         state, m, recs, recorder = _chunk_t_donate(
             cfg, state, keys, recorder, n, w, ring_k, genome, seg_len
         )
+        if perf is not None:
+            perf.dispatched()
         metrics = merge_metrics(metrics, m)
         done += n
-        if callback is not None and callback(done, state, metrics, recs):
+        # The callback's window export (sink append, apply-log update) is
+        # this chunk's host gap; close after it, synced on the chunk metrics.
+        stop = callback is not None and callback(done, state, metrics, recs)
+        if perf is not None:
+            perf.end(sync=lambda: np.asarray(m.ticks))
+        if stop:
             break
     return state, metrics, recorder
 

@@ -17,6 +17,14 @@ shared shape. This module is the one schema all of them write now:
                                tools/metrics_report.py or sim/trace.info_lines.
     <dir>/summary.json         the end-of-run FleetSummary rollup (plus caller
                                extras like wall time).
+    <dir>/perf.jsonl           OPTIONAL: per-chunk runtime attribution rows
+                               (obs/timer.py ChunkTimer) -- wall/dispatch/
+                               host/device-wait seconds, warmup flag, device
+                               live_bytes, jit-cache sizes. Off by default
+                               (--perf); the one stream with floats in it
+                               (wall-clock measurements, not simulation
+                               state), so it is exempt from the integer-exact
+                               rule below.
 
 Everything is line-delimited JSON with integer-exact values (no floats in the
 window stream), so two runs diff textually and `validate()` can check the
@@ -66,6 +74,13 @@ WINDOW_FIELDS = (
     "lm_skipped_pairs",
     "multi_leader",
 )
+
+# Per-line required fields of perf.jsonl (obs/timer.py ChunkTimer rows).
+# Ints, bools, and non-negative float seconds; live_bytes is int-or-null
+# (CPU publishes no memory stats) and jit_cache a {entry point: size} map.
+PERF_INT_FIELDS = ("chunk", "ticks")
+PERF_BOOL_FIELDS = ("warmup", "recompiled")
+PERF_FLOAT_FIELDS = ("wall_s", "dispatch_s", "host_s", "device_wait_s", "gap_s")
 
 MANIFEST_FIELDS = (
     "schema_version",
@@ -133,11 +148,12 @@ class TelemetrySink:
             f.write("\n")
         open(self._path("windows.jsonl"), "w").close()  # truncate the stream
         # A rebuilt run must not inherit the previous run's violation
-        # recordings or rollup: stale flight_*.jsonl under a fresh manifest
-        # would misattribute another run's violations to this one.
+        # recordings, rollup, or perf stream: stale files under a fresh
+        # manifest would misattribute another run's data to this one.
+        # (perf.jsonl is only re-created if a ChunkTimer streams here.)
         for name in os.listdir(directory):
             if (name.startswith("flight_") and name.endswith(".jsonl")) or (
-                name == "summary.json"
+                name in ("summary.json", "perf.jsonl")
             ):
                 os.remove(os.path.join(directory, name))
 
@@ -187,6 +203,15 @@ class TelemetrySink:
                 f.write(json.dumps(line) + "\n")
         self._n_windows += n_windows
         return n_windows
+
+    def append_perf(self, rows: list[dict]) -> int:
+        """Append per-chunk perf-attribution rows (obs/timer.py ChunkTimer)
+        to perf.jsonl. Rows are already plain JSON-able dicts -- the timer is
+        host-side by construction. Returns the number of lines written."""
+        with open(self._path("perf.jsonl"), "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        return len(rows)
 
     def write_flight(self, cluster: int, ticks, infos: StepInfo) -> str:
         """Write one cluster's flight-recorder export (telemetry.export_cluster
@@ -293,6 +318,49 @@ def validate(directory: str) -> list[str]:
                         f"previous window (ends at {prev_end})"
                     )
                 prev_end = row["start"] + row["ticks"]
+
+    perf_path = os.path.join(directory, "perf.jsonl")
+    if os.path.isfile(perf_path):
+        prev_chunk = -1
+        with open(perf_path) as f:
+            for ln, raw in enumerate(f, 1):
+                try:
+                    row = json.loads(raw)
+                except json.JSONDecodeError as ex:
+                    errors.append(f"perf.jsonl:{ln}: not JSON: {ex}")
+                    continue
+                for k in PERF_INT_FIELDS:
+                    if not isinstance(row.get(k), int) or row.get(k) is True:
+                        errors.append(f"perf.jsonl:{ln}: field {k!r} missing or non-int")
+                for k in PERF_BOOL_FIELDS:
+                    if not isinstance(row.get(k), bool):
+                        errors.append(f"perf.jsonl:{ln}: field {k!r} missing or non-bool")
+                for k in PERF_FLOAT_FIELDS:
+                    v = row.get(k)
+                    if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+                        errors.append(
+                            f"perf.jsonl:{ln}: field {k!r} missing or not a "
+                            "non-negative number"
+                        )
+                lb = row.get("live_bytes")
+                if lb is not None and (not isinstance(lb, int) or isinstance(lb, bool)):
+                    errors.append(f"perf.jsonl:{ln}: live_bytes must be int or null")
+                jc = row.get("jit_cache")
+                if not isinstance(jc, dict) or not all(
+                    isinstance(k, str) and isinstance(v, int)
+                    and not isinstance(v, bool) for k, v in jc.items()
+                ):
+                    errors.append(
+                        f"perf.jsonl:{ln}: jit_cache must map entry points to "
+                        "int sizes"
+                    )
+                if isinstance(row.get("chunk"), int):
+                    if row["chunk"] != prev_chunk + 1:
+                        errors.append(
+                            f"perf.jsonl:{ln}: chunk index {row['chunk']} "
+                            f"(expected {prev_chunk + 1})"
+                        )
+                    prev_chunk = row["chunk"]
 
     for name in sorted(os.listdir(directory)):
         if not (name.startswith("flight_") and name.endswith(".jsonl")):

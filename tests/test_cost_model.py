@@ -341,6 +341,25 @@ def test_bench_anchor_rejects_smoke_rows_at_production_batch(tmp_path):
     assert any("--smoke" in n and "ignored" in n for n in notes)
 
 
+def test_bench_anchor_rejects_cpu_backend_rows(tmp_path):
+    """A non-smoke CPU run at production batch (bench >= r06 rows record
+    `backend`) must never rebase the roofline anchor onto host-memory
+    throughput -- the runtime mirror of obs/reconcile.py's non-anchor
+    marking. Backend-less rows (BENCH_r01-r05, all chip-recorded) stay
+    eligible."""
+    (tmp_path / "BENCH_r10.json").write_text(json.dumps({
+        "parsed": {"matrix": {
+            "config5": {"cluster_ticks_per_s": 5.5e4, "batch": 10_000,
+                        "backend": "cpu"},
+            "config4": {"cluster_ticks_per_s": 21.0e6, "batch": 100_000},
+        }},
+    }))
+    anchors, source, notes = CM.anchor(root=str(tmp_path))
+    assert anchors["config5"] == CM.FALLBACK_ANCHOR_R05["config5"]
+    assert anchors["config4"] == 21.0e6  # backend-less chip row still anchors
+    assert any("cpu backend" in n and "ignored" in n for n in notes)
+
+
 def test_failed_carry_derivation_is_a_visible_finding():
     """A scan-kind entry whose run scan could not be located must fire a
     cost-golden finding, not silently skip every carry/roofline comparison

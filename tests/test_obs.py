@@ -1,0 +1,364 @@
+"""The obs subsystem: per-chunk runtime attribution, perf.jsonl schema, and
+measured-vs-predicted reconciliation.
+
+The load-bearing properties, pinned:
+  - perf is INERT: a run with a ChunkTimer attached is bit-exact with one
+    without (the timer is host-side by construction), and `--profile` capture
+    is likewise bit-exact vs no capture.
+  - perf.jsonl is schema'd: the sink validates the stream, and a corrupted
+    row is a visible validation error, not a silent skip.
+  - the recompile watchdog fires on a real mid-run recompile and stays quiet
+    on the known one-time donated-carry respecialization (obs/timer.py
+    docstring).
+  - reconciliation math against the REAL golden Pass C pins, including the
+    trap this PR exists to close: a CPU / smoke / non-production row is
+    explicitly non-anchor and can never rebase the roofline.
+
+Compile budget: one tiny chunk program (module fixture, 3-node shapes shared
+with the forced-recompile test's warm phase), one n=8 chunk variant (the
+forced recompile itself), and one tiny `scan.simulate` (shared by the profile
+guard and the bench steady-stats test). The serve-session and search perf
+streams ride the slow tier: their tier-1 siblings (test_serve, test_scenario)
+already compile those programs, and the hooks they exercise are the same
+ChunkTimer the fixture covers.
+"""
+
+import io
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from raft_sim_tpu import RaftConfig, init_batch
+from raft_sim_tpu.obs import (
+    ChunkTimer,
+    load_pins,
+    reconcile_matrix,
+    reconcile_perf_dir,
+    reconcile_row,
+)
+from raft_sim_tpu.obs.timer import summarize_rows
+from raft_sim_tpu.obs.reconcile import read_perf
+from raft_sim_tpu.sim import chunked, scan
+from raft_sim_tpu.utils import telemetry_sink
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CFG = RaftConfig(n_nodes=3, log_capacity=8, client_interval=4)
+BATCH, TICKS, CHUNK = 2, 64, 16
+
+
+def _setup(seed=0):
+    root = jax.random.key(seed)
+    ki, kr = jax.random.split(root)
+    return init_batch(CFG, ki, BATCH), jax.random.split(kr, BATCH)
+
+
+def tree_eq(a, b, msg=""):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb), err_msg=msg)
+
+
+@pytest.fixture(scope="module")
+def perf_run(tmp_path_factory):
+    """ONE chunked run instrumented with a sink-attached ChunkTimer, plus the
+    identical un-instrumented run -- shared by the bit-exactness, schema, and
+    attribution tests (one compiled chunk program for the module)."""
+    state, keys = _setup()
+    plain = chunked.run_chunked(CFG, state, keys, TICKS, chunk=CHUNK)
+    d = str(tmp_path_factory.mktemp("perf_sink"))
+    sink = telemetry_sink.TelemetrySink(
+        d, CFG, seed=0, batch=BATCH, window=CHUNK, ring=0, source="test"
+    )
+    timer = ChunkTimer(label="run", batch=BATCH, sink=sink)
+    inst = chunked.run_chunked(CFG, state, keys, TICKS, chunk=CHUNK, perf=timer)
+    return {"plain": plain, "inst": inst, "timer": timer, "dir": d,
+            "state": state, "keys": keys}
+
+
+def test_perf_is_bit_exact(perf_run):
+    """Acceptance: the instrumented run's state AND metrics equal the plain
+    run's bit-for-bit -- attribution never perturbs a trajectory."""
+    tree_eq(perf_run["plain"], perf_run["inst"], "perf instrumentation drifted")
+
+
+def test_perf_jsonl_schema_validates(perf_run):
+    assert telemetry_sink.validate(perf_run["dir"]) == []
+    rows = read_perf(perf_run["dir"])
+    assert len(rows) == TICKS // CHUNK
+    assert [r["chunk"] for r in rows] == list(range(len(rows)))
+
+
+def test_perf_attribution_semantics(perf_run):
+    """Warmup flags, phase arithmetic, and the file-vs-live rollup contract."""
+    t = perf_run["timer"]
+    rows = t.rows
+    assert [r["warmup"] for r in rows] == [True, True, False, False]
+    for r in rows:
+        assert r["ticks"] == CHUNK
+        # Phases partition the wall (to rounding).
+        assert abs(
+            r["wall_s"] - (r["dispatch_s"] + r["host_s"] + r["device_wait_s"])
+        ) < 1e-5
+        assert isinstance(r["jit_cache"], dict) and r["jit_cache"]
+    s = t.summary()
+    assert s["steady_chunks"] == 2 and s["steady_ticks"] == 2 * CHUNK
+    assert s["steady_cluster_ticks_per_s"] > 0
+    assert not s["recompiled_after_warmup"]
+    # Re-reading perf.jsonl reproduces the live summary (what metrics_report
+    # --perf renders must be what the driver printed).
+    refile = summarize_rows(read_perf(perf_run["dir"]), label="run", batch=BATCH)
+    assert refile == s
+
+
+def test_validate_catches_corrupt_perf_rows(perf_run, tmp_path):
+    import shutil
+
+    d = str(tmp_path / "bad")
+    shutil.copytree(perf_run["dir"], d)
+    with open(os.path.join(d, "perf.jsonl"), "a") as f:
+        f.write(json.dumps({"chunk": 99, "ticks": -1}) + "\n")
+    errors = telemetry_sink.validate(d)
+    assert any("perf.jsonl" in e and "wall_s" in e for e in errors)
+    assert any("chunk index 99" in e for e in errors)
+
+
+def test_recompile_watchdog_fires_on_forced_recompile(perf_run):
+    """Negative: a chunk-size change mid-stream forces a fresh lowering of
+    the chunk program; the watchdog must mark the row and the summary, and
+    finish() must print the visible finding. The warm phases reuse the
+    fixture's compiled program, so this costs ONE tiny n=8 compile."""
+    state, keys = perf_run["state"], perf_run["keys"]
+    t = ChunkTimer(label="run", batch=BATCH)
+    # Warmup + baseline at the fixture's (cached) chunk shape...
+    chunked.run_chunked(CFG, state, keys, 2 * CHUNK, chunk=CHUNK, perf=t)
+    chunked.run_chunked(CFG, state, keys, 2 * CHUNK, chunk=CHUNK, perf=t)
+    assert not t.summary()["recompiled_after_warmup"]
+    # ...then a different static chunk length = a forced recompile.
+    chunked.run_chunked(CFG, state, keys, 8, chunk=8, perf=t)
+    assert t.rows[-1]["recompiled"]
+    err = io.StringIO()
+    s = t.finish(out=err)
+    assert s["recompiled_after_warmup"]
+    assert "perf watchdog" in err.getvalue()
+    assert "chunked._chunk_donate" in err.getvalue()
+
+
+def test_profile_capture_is_bit_exact(tmp_path):
+    """Tier-1 guard for the promoted --profile flag: a run captured under
+    jax.profiler.trace equals an uncaptured run bit-for-bit."""
+    ref = scan.simulate(CFG, 0, BATCH, 32)
+    with jax.profiler.trace(str(tmp_path / "trace")):
+        cap = scan.simulate(CFG, 0, BATCH, 32)
+    tree_eq(ref, cap, "profiler capture changed the trajectory")
+
+
+def test_bench_rows_carry_steady_stats():
+    """Satellite: bench rows exclude the warmup repeat from steady-state
+    ticks/s, expose per-repeat variance, keep the legacy field under a
+    `legacy` marker, and record the backend (the anchor filter's key)."""
+    import bench as bench_mod
+
+    row = bench_mod.bench(CFG, BATCH, 32, repeats=3, config_name="custom")
+    assert row["steady_ticks_per_s"] > 0
+    assert len(row["repeat_walls_s"]) == 3
+    assert row["repeat_cv"] is not None and row["repeat_cv"] >= 0
+    assert "cluster_ticks_per_s" in row["legacy"]
+    assert row["backend"] == jax.default_backend()
+    # Steady math: mean of the non-warmup walls.
+    steady = row["repeat_walls_s"][1:]
+    expect = BATCH * 32 / np.mean(steady)
+    assert abs(row["steady_ticks_per_s"] - expect) / expect < 0.05
+
+
+# --------------------------------------------------------- reconciliation
+
+
+PINS = load_pins()
+
+
+def test_reconcile_math_against_golden_pins():
+    """Satellite: reconciliation against the REAL Pass C pins -- a synthetic
+    chip row at half the pinned config5 roofline must come back with
+    fraction 0.5, achieved bytes/s = measured x pinned bytes/tick, and
+    anchor eligibility."""
+    pin = PINS["programs"]["config5/simulate"]
+    half = pin["roofline_ticks_per_s"] / 2
+    row = {"steady_ticks_per_s": half, "batch": 10_000, "backend": "tpu"}
+    r = reconcile_row("config5", row, PINS)
+    assert r["anchor"] and r["non_anchor_reasons"] == []
+    assert abs(r["roofline_fraction"] - 0.5) < 1e-3
+    assert abs(
+        r["achieved_bytes_per_s"] - half * pin["bytes_per_tick_padded"]
+    ) < 1.0
+    assert r["measured_source"] == "steady"
+
+
+def test_reconcile_legacy_rows_fall_back_with_note():
+    row = {"cluster_ticks_per_s": 2.0e6, "batch": 10_000}
+    r = reconcile_row("config5", row, PINS, default_backend=None)
+    assert r["measured_source"] == "legacy-best"
+    assert any("legacy" in n for n in r["notes"])
+    # Unknown backend is conservatively non-anchor.
+    assert not r["anchor"]
+    assert any("backend unrecorded" in n for n in r["non_anchor_reasons"])
+
+
+def test_reconcile_cpu_row_never_anchors():
+    """THE trap this subsystem must not reopen: a CPU row at production
+    batch, not smoke, not scenario -- still non-anchor, explicitly."""
+    row = {"steady_ticks_per_s": 5.0e4, "batch": 10_000, "backend": "cpu"}
+    r = reconcile_row("config5", row, PINS)
+    assert not r["anchor"]
+    assert any("CPU run can never rebase" in n for n in r["non_anchor_reasons"])
+    doc = reconcile_matrix({"matrix": {"config5": row}}, pins=PINS)
+    assert doc["anchor_eligible"] == []
+    assert any("must not be saved" in n for n in doc["notes"])
+
+
+def test_reconcile_smoke_and_batch_rules():
+    smoke = {"steady_ticks_per_s": 1e6, "batch": 10_000, "backend": "tpu",
+             "smoke": True}
+    assert not reconcile_row("config5", smoke, PINS)["anchor"]
+    off_batch = {"steady_ticks_per_s": 1e6, "batch": 16, "backend": "tpu"}
+    r = reconcile_row("config5", off_batch, PINS)
+    assert not r["anchor"]
+    assert any("production" in n for n in r["non_anchor_reasons"])
+
+
+def test_reconcile_stale_pin_note():
+    """Measured ABOVE the pinned roofline = the pins are stale; the row must
+    say so (the regenerate signal, mirroring bench's headroom semantics)."""
+    pin = PINS["programs"]["config5/simulate"]
+    row = {"steady_ticks_per_s": pin["roofline_ticks_per_s"] * 1.2,
+           "batch": 10_000, "backend": "tpu"}
+    r = reconcile_row("config5", row, PINS)
+    assert r["roofline_fraction"] > 1.0
+    assert any("stale" in n for n in r["notes"])
+
+
+def test_reconcile_without_pins_degrades_visibly():
+    doc = reconcile_matrix(
+        {"matrix": {"config5": {"steady_ticks_per_s": 1e6, "batch": 10_000,
+                                "backend": "tpu"}}},
+        pins={},
+    )
+    assert any("pins unavailable" in n for n in doc["notes"])
+    assert doc["rows"][0]["roofline_fraction"] is None
+
+
+def test_reconcile_perf_dir_joins_manifest_and_rows(perf_run):
+    res = reconcile_perf_dir(perf_run["dir"], pins=PINS)
+    s = perf_run["timer"].summary()
+    assert res["summary"]["steady_cluster_ticks_per_s"] == (
+        s["steady_cluster_ticks_per_s"]
+    )
+    r = res["reconciliation"]
+    assert not r["anchor"]  # cpu backend from the manifest
+    # The module config matches no preset: reported, not crashed.
+    assert any("no preset" in n for n in r["notes"])
+
+
+# ------------------------------------------------- measurement-pass artifact
+
+
+def _synthetic_measurement(tmp_path) -> str:
+    doc = {
+        "schema": "measurement-pass-v1",
+        "backend": "cpu", "jax_version": jax.__version__, "smoke": True,
+        "repeats": 2,
+        "matrix": {"config5": {"steady_ticks_per_s": 5.0e4, "batch": 16,
+                               "backend": "cpu", "smoke": True}},
+        "ab": {
+            "bitpack_vs_r05": {"r05": {}, "measured": {},
+                               "measured_over_r05": {}, "notes": []},
+            "fault_lattice": {"label": "x", "off": {}, "on": {},
+                              "on_over_off_ticks_per_s": 0.5, "notes": []},
+            "serve_offer_plane": {"label": "x", "off": {}, "on": {},
+                                  "on_over_off_ticks_per_s": 0.99, "notes": []},
+        },
+        "reconciliation": reconcile_matrix(
+            {"matrix": {"config5": {"steady_ticks_per_s": 5.0e4, "batch": 16,
+                                    "backend": "cpu", "smoke": True}}},
+            pins=PINS,
+        ),
+        "trajectory": [{"source": "BENCH_r05.json", "round": 5,
+                        "ticks_per_s": {"config5": 2078975.4}}],
+        "notes": ["newest hardware artifact is round 5"],
+    }
+    path = str(tmp_path / "MEASUREMENT_r99.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def test_measurement_report_renders(tmp_path):
+    from tools import metrics_report
+
+    out = io.StringIO()
+    metrics_report.report_measurement(_synthetic_measurement(tmp_path), out=out)
+    text = out.getvalue()
+    assert "measured vs predicted" in text
+    assert "non-anchor" in text
+    assert "fault_lattice" in text and "serve_offer_plane" in text
+    assert "BENCH_r05.json" in text  # the trajectory table
+    assert "round 5" in text  # the unmeasured-gap flag
+
+
+def test_measurement_report_refuses_unknown_schema(tmp_path):
+    from tools import metrics_report
+
+    path = str(tmp_path / "bogus.json")
+    with open(path, "w") as f:
+        json.dump({"schema": "not-a-measurement"}, f)
+    with pytest.raises(SystemExit):
+        metrics_report.report_measurement(path)
+
+
+# ------------------------------------------------------ loop streams (slow)
+
+
+@pytest.mark.slow
+def test_serve_session_perf_stream(tmp_path):
+    """The serve loop's perf stream: warmup accounting covers the session's
+    warmup chunks + the respecialization chunk, rows validate through the
+    sink, and the flat-cache discipline test_serve pins shows up as a quiet
+    watchdog. Slow tier: the tier-1 serve fixture already compiles this
+    program shape; this exercises only the timer plumbing around it."""
+    from raft_sim_tpu.serve.ingest import CommandSource
+    from raft_sim_tpu.serve.loop import ServeSession, serve_config
+
+    cfg = serve_config(RaftConfig(n_nodes=3, log_capacity=8))
+    d = str(tmp_path / "sink")
+    sink = telemetry_sink.TelemetrySink(
+        d, cfg, seed=0, batch=BATCH, window=16, ring=0, source="serve"
+    )
+    t = ChunkTimer(label="serve", batch=BATCH, sink=sink)
+    sess = ServeSession(cfg, batch=BATCH, seed=0, chunk=32, window=16,
+                        sink=sink, warmup_ticks=32, perf=t)
+    stats = sess.serve(CommandSource(iter([5, 6, 7])), drain_chunks=2)
+    assert stats["perf"]["chunks"] == len(t.rows) >= 3
+    # Session warmup chunk + first serving chunk are both warmup rows.
+    assert t.warmup_chunks == 2
+    assert not stats["perf"]["recompiled_after_warmup"]
+    assert telemetry_sink.validate(d) == []
+
+
+@pytest.mark.slow
+def test_search_perf_stream():
+    """The hunt's per-generation attribution: one row per generation, the
+    windowed program's cache sampled and flat (genomes are traced data)."""
+    from raft_sim_tpu.scenario import search as search_mod
+
+    t = ChunkTimer(label="search", batch=8)
+    spec = search_mod.SearchSpec(generations=3, population=8, ticks=32,
+                                 window=16)
+    search_mod.search(CFG, spec, perf=t)
+    assert len(t.rows) == 3
+    assert all(r["ticks"] == 32 for r in t.rows)
+    caches = [r["jit_cache"]["telemetry.simulate_windowed"] for r in t.rows]
+    assert len(set(caches)) == 1
+    assert not t.summary()["recompiled_after_warmup"]

@@ -126,7 +126,7 @@ def _fmt(v) -> str:
     return f"{v:,}"
 
 
-def report(directory: str, n_windows: int, out=sys.stdout) -> None:
+def report(directory: str, n_windows: int, out=None) -> None:
     man = sink.read_manifest(directory)
     rows = sink.read_windows(directory)
     totals = _merge_windows(rows)
@@ -171,7 +171,7 @@ def report(directory: str, n_windows: int, out=sys.stdout) -> None:
         )
 
 
-def render_flight(directory: str, cluster: int, out=sys.stdout) -> None:
+def render_flight(directory: str, cluster: int, out=None) -> None:
     """Rebuild the stacked StepInfo from a flight_<c>.jsonl and render it with
     the same decoder the live trace path uses (sim/trace.info_lines)."""
     from raft_sim_tpu.sim import trace
@@ -192,12 +192,135 @@ def render_flight(directory: str, cluster: int, out=sys.stdout) -> None:
         print(f"tick {t:>8}  {line[line.index('leader='):]}", file=out)
 
 
-def diff(path_a: str, path_b: str, config: str | None, out=sys.stdout) -> None:
+def report_perf_dir(directory: str, out=None) -> None:
+    """Render a telemetry directory's perf.jsonl (obs.ChunkTimer rows): the
+    per-chunk attribution table, the steady-state rollup, and the
+    reconciliation of measured throughput against the cost-model pins."""
+    from raft_sim_tpu.obs import reconcile
+
+    rows = reconcile.read_perf(directory)
+    if not rows:
+        raise SystemExit(
+            f"{directory}: no perf.jsonl (run with --perf to record one)"
+        )
+    res = reconcile.reconcile_perf_dir(directory)
+    s = res["summary"]
+    print(f"perf stream: {directory} ({len(rows)} chunks, "
+          f"{s['steady_chunks']} steady)", file=out)
+    cols = ("chunk", "ticks", "wall_s", "dispatch_s", "host_s",
+            "device_wait_s", "gap_s")
+    print("  " + " ".join(f"{c:>13}" for c in cols) + "  flags", file=out)
+    for r in rows:
+        flags = "warmup" if r.get("warmup") else ""
+        if r.get("recompiled"):
+            flags += " RECOMPILED"
+        print("  " + " ".join(f"{_fmt(r[c]):>13}" for c in cols)
+              + f"  {flags}", file=out)
+    print("\n  steady state:", file=out)
+    for k in ("steady_ticks", "steady_wall_s", "steady_cluster_ticks_per_s",
+              "device_wait_s", "host_gap_s", "host_gap_frac",
+              "live_bytes_peak", "recompiled_after_warmup"):
+        v = s.get(k)
+        v = str(v) if isinstance(v, bool) else _fmt(v)
+        print(f"  {k:28} {v:>14}", file=out)
+    for name, size in (s.get("jit_cache_final") or {}).items():
+        print(f"  jit cache {name:28} {size}", file=out)
+    _print_reconciliation([res["reconciliation"]], out=out)
+
+
+def _print_reconciliation(rows: list[dict], out=None) -> None:
+    print("\n  measured vs predicted (cost-model pins):", file=out)
+    cols = ("config", "measured_ticks_per_s", "predicted_roofline_ticks_per_s",
+            "roofline_fraction", "achieved_bytes_per_s", "anchor")
+    hdr = ("config", "measured t/s", "predicted t/s", "roofline frac",
+           "achieved B/s", "anchor")
+    print("  " + " ".join(f"{h:>16}" for h in hdr), file=out)
+    for r in rows:
+        vals = []
+        for c in cols:
+            v = r.get(c)
+            if c == "anchor":
+                vals.append("ANCHOR" if v else "non-anchor")
+            elif isinstance(v, str):
+                vals.append(v)
+            else:
+                vals.append(_fmt(v))
+        print("  " + " ".join(f"{v:>16}" for v in vals), file=out)
+    for r in rows:
+        for reason in r.get("non_anchor_reasons", []):
+            print(f"    {r['config']}: non-anchor: {reason}", file=out)
+        for note in r.get("notes", []):
+            print(f"    {r['config']}: note: {note}", file=out)
+
+
+def report_measurement(path: str, out=None) -> None:
+    """Render a MEASUREMENT_r*.json artifact (bench.py --measurement-pass):
+    the measured-vs-predicted roofline table, the three A/B deltas, and the
+    BENCH_r01 -> now trajectory with the unmeasured gap flagged."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != "measurement-pass-v1":
+        raise SystemExit(
+            f"{path}: not a measurement-pass artifact "
+            f"(schema {doc.get('schema')!r})"
+        )
+    print(
+        f"measurement pass: {path}\n"
+        f"  backend={doc.get('backend')} jax={doc.get('jax_version')} "
+        f"smoke={doc.get('smoke')} repeats={doc.get('repeats')}",
+        file=out,
+    )
+    rec = doc.get("reconciliation") or {}
+    _print_reconciliation(rec.get("rows", []), out=out)
+    for note in rec.get("notes", []):
+        print(f"  note: {note}", file=out)
+
+    print("\n  A/B deltas:", file=out)
+    ab = doc.get("ab") or {}
+    for key in ("fault_lattice", "serve_offer_plane"):
+        arm = ab.get(key) or {}
+        ratio = arm.get("on_over_off_ticks_per_s")
+        print(f"  {key:18} on/off throughput ratio: {_fmt(ratio)} "
+              f"({arm.get('label', '')})", file=out)
+        for note in arm.get("notes", []):
+            print(f"    note: {note}", file=out)
+    bp = ab.get("bitpack_vs_r05") or {}
+    print("  bitpack_vs_r05     measured/r05 per config: "
+          + (", ".join(f"{k}={_fmt(v)}" for k, v in
+                       (bp.get("measured_over_r05") or {}).items())
+             or "(not computable on this backend/sizing)"), file=out)
+    for note in bp.get("notes", []):
+        print(f"    note: {note}", file=out)
+
+    traj = doc.get("trajectory") or []
+    if traj:
+        configs = sorted({c for t in traj for c in t.get("ticks_per_s", {})})
+        print("\n  trajectory (BENCH_r01 -> now, legacy headline t/s):", file=out)
+        print("  " + f"{'artifact':>16}" + " ".join(f"{c:>14}" for c in configs),
+              file=out)
+        for t in traj:
+            vals = [t["ticks_per_s"].get(c) for c in configs]
+            print("  " + f"{t['source']:>16}"
+                  + " ".join(f"{_fmt(v):>14}" for v in vals), file=out)
+        this = {
+            n: r.get("steady_ticks_per_s")
+            for n, r in (doc.get("matrix") or {}).items() if n in configs
+        }
+        print("  " + f"{'this pass':>16}"
+              + " ".join(f"{_fmt(this.get(c)):>14}" for c in configs)
+              + f"  [{doc.get('backend')}{' smoke' if doc.get('smoke') else ''}]",
+              file=out)
+    for note in doc.get("notes", []):
+        print(f"  note: {note}", file=out)
+
+
+def diff(path_a: str, path_b: str, config: str | None, out=None) -> None:
     label_a, a = load_run(path_a, config)
     label_b, b = load_run(path_b, config)
     keys = [k for k in (
         "violations", "cmds", "msgs", "max_commit", "p50_stable_tick",
-        "cluster_ticks_per_s", "predicted_roofline_ticks_per_s",
+        "cluster_ticks_per_s", "steady_ticks_per_s", "repeat_cv",
+        "predicted_roofline_ticks_per_s",
         "roofline_headroom", "mean_commit_latency", "p50_commit_latency",
         "lat_p50", "lat_p95", "lat_p99", "lat_excluded", "noop_blocked",
         "lm_skipped_pairs", "multi_leader",
@@ -227,7 +350,29 @@ def main(argv=None) -> int:
                     help="window-table rows to show (default 8)")
     ap.add_argument("--flight", type=int, default=None, metavar="CLUSTER",
                     help="render flight_<CLUSTER>.jsonl via trace.info_lines")
+    ap.add_argument("--perf", action="store_true",
+                    help="runtime-perf report: a telemetry directory's "
+                         "perf.jsonl (chunk attribution + reconciliation vs "
+                         "the cost-model pins) or a MEASUREMENT_r*.json "
+                         "artifact (measured-vs-predicted roofline table, "
+                         "A/B deltas, BENCH trajectory)")
     args = ap.parse_args(argv)
+
+    if args.perf:
+        if len(args.paths) != 1:
+            ap.error("--perf needs exactly one path (telemetry dir or "
+                     "MEASUREMENT_r*.json)")
+        path = args.paths[0]
+        if os.path.isdir(path):
+            errors = sink.validate(path)
+            if errors:
+                for e in errors:
+                    print(f"INVALID: {e}", file=sys.stderr)
+                return 1
+            report_perf_dir(path)
+        else:
+            report_measurement(path)
+        return 0
 
     if args.diff:
         if len(args.paths) != 2:
