@@ -58,14 +58,15 @@ RULES = frozenset({
 })
 
 # Packages whose functions must not branch on traced values.
-TRACED_BRANCH_DIRS = ("models", "sim")
+TRACED_BRANCH_DIRS = ("models", "sim", "trace")
 # Packages where float literals must not enter jnp/lax calls.
-FLOAT_LITERAL_DIRS = ("models", "sim", "ops")
+FLOAT_LITERAL_DIRS = ("models", "sim", "ops", "trace")
 
 # Parameter annotations that mark a value as traced.
 TRACED_ANNOTATIONS = {
     "ClusterState", "StepInputs", "Mailbox", "StepInfo", "RunMetrics",
     "FlightRecorder", "WindowRecord", "Array", "jax.Array",
+    "TickEvents", "TraceWin", "TracePersist",
 }
 
 # Config tiers the dtype-comment contract is verified against: the int8 index

@@ -516,13 +516,17 @@ def donation_audit() -> tuple:
 def derive_program(key: str, closed, kind: str, cfg: RaftConfig, batch: int) -> dict:
     peak, temp = live_peak_bytes(closed)
     entry: dict = {"kind": kind, "live_peak": peak, "temp_bytes": temp}
-    if kind not in ("scan", "serve_scan"):
+    if kind not in ("scan", "serve_scan", "trace_scan"):
         return entry
     # serve_scan: the widest scan is the serve loop's inner window scan, whose
     # carry = the (state, metrics) template + the first-violation aux leg --
     # so the offer-tick plane legs are priced exactly like every other carry
     # leg (ISSUE 6: the plane's cost is a gated number, not prose).
-    cm = carry_model(closed, batch)
+    # trace_scan: likewise, plus the named trace ring/coverage legs
+    # (policy.trace_carry_leaf_names) -- the trace plane's sizing guidance in
+    # docs/OBSERVABILITY.md reads from these pins.
+    names = policy.trace_carry_leaf_names() if kind == "trace_scan" else None
+    cm = carry_model(closed, batch, names=names)
     if cm is None:
         entry["error"] = "no scan found in a scan-kind program"
         return entry

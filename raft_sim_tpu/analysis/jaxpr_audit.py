@@ -89,6 +89,10 @@ _AUDIT_WINDOW = 16
 # check below pins.
 _AUDIT_SEGMENTS = 2
 _AUDIT_SEG_LEN = 16
+# Event-ring depth of the audited TRACE program (trace/ring.py TraceSpec):
+# shape-like static, small to keep the audit lowering fast -- depth scales
+# the carry planes, never the program structure.
+_AUDIT_TRACE_DEPTH = 32
 
 # (preset, replacements) pairs for rule recompile-fork: every replacement is a
 # pure tuning-knob change (probabilities, cadences, horizons) that must lower
@@ -208,19 +212,64 @@ def serve_scan_jaxpr(
     )(seed, cmds)
 
 
+def trace_variant(cfg: RaftConfig) -> RaftConfig:
+    """The trace-mode config a tier's traced program is audited under
+    (cfg.track_trace raised; nothing else moves -- with it off the tier's
+    standing programs carry NO trace leg, which the unchanged simulate/
+    scenario/serve pins prove every gate run)."""
+    return dataclasses.replace(cfg, track_trace=True)
+
+
+@functools.lru_cache(maxsize=None)
+def trace_scan_jaxpr(
+    cfg: RaftConfig,
+    batch: int = _AUDIT_BATCH,
+    ticks: int = _AUDIT_TICKS,
+    window: int = _AUDIT_WINDOW,
+    depth: int = _AUDIT_TRACE_DEPTH,
+):
+    """ClosedJaxpr of the protocol-trace program (`telemetry.simulate_windowed`
+    with a TraceSpec: the windowed scan plus the event ring + coverage carry
+    legs, trace/ring.py). NOTE: callers pass the TRACE-mode config
+    (`trace_variant`) -- the trace legs exist only there, and the carry rules
+    run under it so their cost is a pinned number, not prose."""
+    from raft_sim_tpu.sim import telemetry
+    from raft_sim_tpu.trace.ring import TraceSpec
+
+    seed = jax.ShapeDtypeStruct((), jnp.int32)
+    spec = TraceSpec(depth=depth)
+    return jax.make_jaxpr(
+        lambda s: telemetry.simulate_windowed(
+            cfg, s, batch, ticks, window, 0, None, 1, spec
+        )
+    )(seed)
+
+
+def trace_extra_legs() -> int:
+    """Auxiliary carry legs the trace program's tick loop rides beyond the
+    (state, metrics) template: the window first-violation tick plus the
+    TraceWin/TracePersist leaves (trace/ring.py)."""
+    from raft_sim_tpu.trace.ring import TracePersist, TraceWin
+
+    return 1 + len(TraceWin._fields) + len(TracePersist._fields)
+
+
 def programs(name: str, cfg: RaftConfig):
     """The audited programs for one config tier: both step kernels, the full
-    scan, the scenario (genome-path) scan, and the standing-fleet serve scan.
-    Yields (program_name, closed_jaxpr, kind, rule_cfg) -- `rule_cfg` is the
-    config the per-program rules (carry passthrough/dtype, input pricing) run
-    under: the tier's own config, except for the serve program, which is
-    audited under its serve-mode variant (offer-tick plane live)."""
+    scan, the scenario (genome-path) scan, the standing-fleet serve scan, and
+    the protocol-trace scan. Yields (program_name, closed_jaxpr, kind,
+    rule_cfg) -- `rule_cfg` is the config the per-program rules (carry
+    passthrough/dtype, input pricing) run under: the tier's own config,
+    except for the serve/trace programs, which are audited under their
+    serve-mode / trace-mode variants (offer-tick plane / trace legs live)."""
     yield f"jaxpr:{name}/step", step_jaxpr(cfg, batched=False), "step", cfg
     yield f"jaxpr:{name}/step_b", step_jaxpr(cfg, batched=True), "step", cfg
     yield f"jaxpr:{name}/simulate", scan_jaxpr(cfg), "scan", cfg
     yield f"jaxpr:{name}/scenario_simulate", scenario_scan_jaxpr(cfg), "scan", cfg
     scfg = serve_variant(cfg)
     yield f"jaxpr:{name}/serve_simulate", serve_scan_jaxpr(scfg), "serve_scan", scfg
+    tcfg = trace_variant(cfg)
+    yield f"jaxpr:{name}/trace_simulate", trace_scan_jaxpr(tcfg), "trace_scan", tcfg
 
 
 # ------------------------------------------------------------- jaxpr walking
@@ -502,6 +551,9 @@ def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
             # a tuned value leaking into the serve chunk's structure would
             # recompile the standing fleet mid-session.
             ("serve_simulate", lambda c: serve_scan_jaxpr(serve_variant(c))),
+            # The coverage search's one-compiled-program claim: trace-mode
+            # evaluations across a fault sweep must share a program too.
+            ("trace_simulate", lambda c: trace_scan_jaxpr(trace_variant(c))),
         ):
             h_base = structural_hash(lower(base))
             h_var = structural_hash(lower(variant))
@@ -542,8 +594,12 @@ def run_pass(config_names=AUDIT_CONFIGS, fork_pairs=FORK_PAIRS) -> list[Finding]
                 out.extend(check_plane_widening(prog, closed, rule_cfg))
             else:
                 # The serve program's tick loop rides one auxiliary carry leg
-                # (the window's first-violation tick -- serve/loop.py).
-                extra = 1 if kind == "serve_scan" else 0
+                # (the window's first-violation tick -- serve/loop.py); the
+                # trace program rides that plus the event ring + coverage
+                # legs (trace/ring.py).
+                extra = {"serve_scan": 1, "trace_scan": trace_extra_legs()}.get(
+                    kind, 0
+                )
                 out.extend(
                     check_carry_passthrough(prog, closed, rule_cfg, extra_legs=extra)
                 )

@@ -227,6 +227,21 @@ def scenario_genome_leaves() -> list[tuple[str, str]]:
     ]
 
 
+def trace_carry_leaf_names() -> list[str]:
+    """Leaf names of the TRACE program's tick-loop carry: the (state,
+    metrics) template, the window first-violation tick, then the trace
+    window/persist legs (trace/ring.py) -- so the cost model's
+    `cost-carry-bytes` findings name `trace.ev_kind`, not `extra17`, when a
+    trace leg widens."""
+    from raft_sim_tpu.trace.ring import TracePersist, TraceWin
+
+    names = carry_leaf_names()
+    names.append("first_viol")
+    names.extend(f"trace.{f}" for f in TraceWin._fields)
+    names.extend(f"trace.{f}" for f in TracePersist._fields)
+    return names
+
+
 def carry_leaf_names() -> list[str]:
     """Flattened leaf names of the batch-minor scan carry (state, metrics), in
     pytree flatten order -- the order of the scan body jaxpr's carry vars.

@@ -84,6 +84,9 @@ class Session:
         self._tel_rec = None  # flight-recorder carry (batch-minor)
         self._deltas = None  # serve.DeltaStream (offer's commit-ack watcher)
         self.perf = None  # obs.ChunkTimer (attach_perf)
+        self._trace_spec = None  # trace.TraceSpec (attach_trace)
+        self._trace_persist = None  # cross-chunk trace carry (batch-minor)
+        self._trace_trigger = None  # flight-recorder event-kind trigger
         self.reset()
 
     def reset(self) -> None:
@@ -111,6 +114,14 @@ class Session:
         # above already truncated the sink's perf.jsonl).
         if self.perf is not None:
             self.attach_perf(warmup_chunks=self.perf.warmup_chunks)
+        # ... and a fresh trace stream (the telemetry re-attach truncated the
+        # trace files; re-arming rewrites trace_meta.json and zeroes the
+        # cross-window carry).
+        if self._trace_spec is not None:
+            spec = self._trace_spec
+            self._trace_persist = None
+            if self.telemetry is not None:
+                self.telemetry.write_trace_meta(spec)
 
     def _apply_sharding(self) -> None:
         if self.devices is None:
@@ -171,6 +182,53 @@ class Session:
             telemetry.init_recorder(self.cfg, ring, self.batch) if ring else None
         )
 
+    def attach_trace(
+        self,
+        depth: int = 128,
+        freeze: str | None = None,
+        trigger: str | None = None,
+        coverage: bool = True,
+    ) -> None:
+        """Arm the protocol trace plane (raft_sim_tpu/trace; requires
+        cfg.track_trace and an attached telemetry sink): run() extracts
+        per-cluster protocol events on device and streams them per window as
+        trace.jsonl + trace_windows.jsonl for timeline rendering
+        (tools/metrics_report.py --trace) and whole-history checking
+        (python -m raft_sim_tpu.trace.checker). `freeze` (an event-kind name,
+        trace.KINDS) stops a cluster's recording after the first occurrence
+        of that kind; `trigger` re-arms the FLIGHT RECORDER's freeze on an
+        event kind instead of the default violation trigger -- "capture the
+        lead-up to the first leadership change/crash" (docs/OBSERVABILITY.md,
+        trigger semantics)."""
+        from raft_sim_tpu.trace import KINDS, TraceSpec
+
+        if not self.cfg.track_trace:
+            raise ValueError(
+                "attach_trace needs cfg.track_trace=True (the trace plane is "
+                "a structural config gate -- utils/config.py)"
+            )
+        if self.telemetry is None:
+            raise RuntimeError(
+                "attach_trace needs an attached telemetry sink "
+                "(attach_telemetry): trace windows stream through it"
+            )
+
+        def kind_code(name, what):
+            if name is None:
+                return None
+            if name not in KINDS:
+                raise ValueError(
+                    f"unknown {what} event kind {name!r} (have {sorted(KINDS)})"
+                )
+            return KINDS[name]
+
+        self._trace_spec = TraceSpec(
+            depth=depth, coverage=coverage, freeze_kind=kind_code(freeze, "freeze") or 0
+        )
+        self._trace_trigger = kind_code(trigger, "trigger")
+        self._trace_persist = None
+        self.telemetry.write_trace_meta(self._trace_spec)
+
     def attach_perf(self, warmup_chunks: int | None = None) -> None:
         """Arm per-chunk runtime attribution (obs.ChunkTimer): run() streams
         perf.jsonl rows into the attached telemetry sink (or keeps them on
@@ -202,11 +260,27 @@ class Session:
                 progress_line(done, metrics)
                 return False
 
-            self.state, m, self._tel_rec = telemetry.run_chunked_telemetry(
-                self.cfg, self.state, self.keys, n_ticks,
-                window=self.telemetry.window, recorder=self._tel_rec,
-                chunk=chunk, callback=cb_t, perf=self.perf,
-            )
+            if self._trace_spec is not None or self._trace_trigger is not None:
+                out = telemetry.run_chunked_telemetry(
+                    self.cfg, self.state, self.keys, n_ticks,
+                    window=self.telemetry.window, recorder=self._tel_rec,
+                    chunk=chunk, callback=cb_t, perf=self.perf,
+                    trace_spec=self._trace_spec,
+                    trace_persist=self._trace_persist,
+                    trigger_kind=self._trace_trigger,
+                    trace_callback=lambda done, traws:
+                        self.telemetry.append_trace(traws),
+                )
+                if self._trace_spec is not None:
+                    self.state, m, self._tel_rec, self._trace_persist = out
+                else:
+                    self.state, m, self._tel_rec = out
+            else:
+                self.state, m, self._tel_rec = telemetry.run_chunked_telemetry(
+                    self.cfg, self.state, self.keys, n_ticks,
+                    window=self.telemetry.window, recorder=self._tel_rec,
+                    chunk=chunk, callback=cb_t, perf=self.perf,
+                )
             self.metrics = chunked.merge_metrics(self.metrics, m)
             return
 
@@ -224,28 +298,53 @@ class Session:
 
     def finalize_telemetry(self, max_flights: int = 8) -> dict:
         """End-of-experiment telemetry export: write summary.json and, for up
-        to `max_flights` clusters whose flight recorder froze on a violation,
-        the recorder's final ticks as flight_<cluster>.jsonl. Returns
-        {"flights": [cluster ids exported], "summary": path}."""
+        to `max_flights` clusters whose flight recorder froze (on a violation,
+        or on the armed trigger kind -- attach_trace), the recorder's final
+        ticks as flight_<cluster>.jsonl. Returns {"flights": [cluster ids
+        exported], "flights_frozen": total frozen count, "flights_exported":
+        count actually written, "summary": path} -- the frozen-vs-exported
+        totals are also in summary.json, so clusters dropped by the
+        max_flights cap are a REPORTED number, never a silent one."""
         if self.telemetry is None:
             raise RuntimeError("no telemetry attached (attach_telemetry)")
         from raft_sim_tpu.sim import telemetry
 
         flights = []
+        frozen_total = 0
         if self._tel_rec is not None:
             frozen = np.flatnonzero(np.asarray(self._tel_rec.frozen))
+            frozen_total = int(frozen.size)
             for cluster in frozen[:max_flights]:
                 ticks, infos = telemetry.export_cluster(self._tel_rec, int(cluster))
                 self.telemetry.write_flight(int(cluster), ticks, infos)
                 flights.append(int(cluster))
             if frozen.size > max_flights:
                 print(
-                    f"telemetry: {frozen.size} violating clusters, exported "
-                    f"first {max_flights} flight recordings",
+                    f"telemetry: {frozen.size} frozen clusters, exported "
+                    f"first {max_flights} flight recordings "
+                    f"({frozen.size - max_flights} not exported -- raise "
+                    "max_flights to keep them)",
                     file=sys.stderr,
                 )
-        path = self.telemetry.write_summary(self.summary())
-        return {"flights": flights, "summary": path}
+        summary = self.summary()
+        summary["flights_frozen"] = frozen_total
+        summary["flights_exported"] = len(flights)
+        if self._trace_persist is not None:
+            from raft_sim_tpu.trace.ring import cov_popcount
+
+            tp = self._trace_persist
+            summary["trace"] = {
+                "events_emitted": int(np.asarray(tp.total, np.int64).sum()),
+                "frozen_clusters": int(np.asarray(tp.frozen).sum()),
+                "cov_bits_max": int(np.asarray(cov_popcount(tp.cov)).max()),
+            }
+        path = self.telemetry.write_summary(summary)
+        return {
+            "flights": flights,
+            "flights_frozen": frozen_total,
+            "flights_exported": len(flights),
+            "summary": path,
+        }
 
     def offer(self, value: int, wait: int = 0) -> dict:
         """Offer one client command and advance one tick -- the reference's ad-hoc
@@ -272,6 +371,17 @@ class Session:
         node 0 is crashed): size `wait` accordingly.
         """
         value = int(value)
+        if self._trace_spec is not None:
+            # offer() ticks run outside the windowed telemetry scan, so their
+            # events would be MISSING from the trace stream while the ticks
+            # stay monotone -- an undetectable hole the checker would then
+            # PASS over (the vacuous-pass class trace/history.py exists to
+            # prevent). Refuse rather than record a silently gappy history.
+            raise RuntimeError(
+                "Session.offer() ticks are not covered by the armed trace "
+                "stream; detach the trace, or ingest via run()'s scheduled "
+                "cadence / the serve loop instead"
+            )
         from raft_sim_tpu.serve.ingest import check_value
 
         check_value(value)  # same NIL/NOOP/int32 rule as the serve ingest
@@ -380,6 +490,9 @@ class Session:
         self._tel_rec = None
         self._deltas = None
         self.perf = None
+        self._trace_spec = None
+        self._trace_persist = None
+        self._trace_trigger = None
         self.cfg = cfg
         self.batch = state.role.shape[0]
         self.seed = seed
@@ -563,6 +676,8 @@ def _scenario_search(args, ap) -> int:
         window=args.window,
         elite_frac=args.elite_frac,
         seed=args.seed if args.seed is not None else 0,
+        fitness=args.fitness,
+        trace_depth=args.trace_depth,
     )
     try:
         with _profile_ctx(args.profile):
@@ -736,6 +851,37 @@ def main(argv=None) -> int:
                        help="flight-recorder depth: last K ticks of StepInfo "
                             "per cluster, frozen at the first violation "
                             "(0 disables; default 32)")
+    run_p.add_argument("--trace", action="store_true",
+                       help="protocol trace plane (raft_sim_tpu/trace; "
+                            "requires --telemetry-dir): extract per-cluster "
+                            "protocol events on device and stream them as "
+                            "trace.jsonl for timeline rendering "
+                            "(tools/metrics_report.py --trace) and "
+                            "whole-history Raft safety checking "
+                            "(python -m raft_sim_tpu.trace.checker DIR). "
+                            "Sets cfg.track_trace; trajectories stay "
+                            "bit-exact vs an untraced run")
+    run_p.add_argument("--trace-depth", type=int, default=128, metavar="R",
+                       help="events retained per cluster per telemetry "
+                            "window (overflow is counted, the checker then "
+                            "reports the history incomplete; default 128)")
+    run_p.add_argument("--trace-freeze", metavar="KIND", default=None,
+                       help="stop a cluster's trace recording after the "
+                            "first event of KIND (e.g. 'leader', 'crash'; "
+                            "default: record forever). Capture economy, not "
+                            "checking: the whole-history checker reports a "
+                            "freeze-truncated stream as undecided, never as "
+                            "a pass")
+    run_p.add_argument("--trace-trigger", metavar="KIND", default=None,
+                       help="freeze the FLIGHT RECORDER on the first event "
+                            "of KIND instead of the first violation -- "
+                            "capture the lead-up to a non-violating anomaly "
+                            "(implies cfg.track_trace; default: violation)")
+    run_p.add_argument("--mutant", default=None, metavar="NAME",
+                       help="TEST-ONLY: run a deliberately weakened kernel "
+                            "(scenario/mutation.py registry, e.g. "
+                            "'weak-quorum') -- the trace/checker CI smoke's "
+                            "known-bad target")
     run_p.add_argument("--perf", action="store_true",
                        help="per-chunk runtime attribution (obs.ChunkTimer): "
                             "device-vs-host wall split, warmup vs steady "
@@ -841,6 +987,18 @@ def main(argv=None) -> int:
     ssearch.add_argument("--window", type=int, default=64,
                          help="telemetry window (fitness resolution)")
     ssearch.add_argument("--elite-frac", type=float, default=0.25)
+    ssearch.add_argument("--fitness", choices=("scalar", "coverage"),
+                         default="scalar",
+                         help="fitness mode: 'scalar' = the hand-tuned "
+                              "distress weights; 'coverage' = transition-"
+                              "coverage novelty from the protocol trace "
+                              "plane (newly set role x event-kind and "
+                              "kind->kind bits across the fleet; violations "
+                              "stay dominant) -- one compiled trace-variant "
+                              "program for the whole hunt")
+    ssearch.add_argument("--trace-depth", type=int, default=32, metavar="R",
+                         help="coverage mode's per-window event-buffer depth "
+                              "(the bitmap needs no deep buffer; default 32)")
     ssearch.add_argument("--seed", type=int, default=None)
     ssearch.add_argument("--backend", default="auto", metavar="NAME")
     ssearch.add_argument("--out", metavar="FILE", default=None,
@@ -896,6 +1054,10 @@ def main(argv=None) -> int:
             conflicting.append("batch")
         if args.seed is not None:
             conflicting.append("seed")  # the checkpoint carries its own seed
+        if args.mutant:
+            conflicting.append("mutant")
+        if args.trace or args.trace_trigger or args.trace_freeze:
+            conflicting.append("trace")  # track_trace is part of the config
         if conflicting:
             ap.error(f"--resume is exclusive with config flags: {', '.join(conflicting)}")
         # Checkpoint problems (bad path, stale format) surface as real errors;
@@ -909,6 +1071,22 @@ def main(argv=None) -> int:
                 ap.error(str(ex))
     else:
         cfg, batch = build_config(args)
+        if args.mutant:
+            from raft_sim_tpu.scenario.mutation import mutant_config
+
+            try:
+                cfg = mutant_config(args.mutant, cfg)
+            except ValueError as ex:
+                ap.error(str(ex))
+        if args.trace or args.trace_trigger or args.trace_freeze:
+            # --trace-trigger / --trace-freeze imply the trace plane: both
+            # are meaningless without the extracted event stream, so an
+            # explicitly set kind must never be silently dropped.
+            if not args.telemetry_dir:
+                ap.error("--trace/--trace-trigger/--trace-freeze need "
+                         "--telemetry-dir (trace windows stream through the "
+                         "telemetry sink)")
+            cfg = dataclasses.replace(cfg, track_trace=True)
         try:
             sess = Session(
                 cfg,
@@ -950,6 +1128,18 @@ def main(argv=None) -> int:
             )
         except ValueError as ex:
             ap.error(str(ex))
+        if args.trace or args.trace_trigger or args.trace_freeze:
+            # --trace-trigger/--trace-freeze imply the trace plane: their
+            # predicates are computed from the same extracted events the
+            # stream exports.
+            try:
+                sess.attach_trace(
+                    depth=args.trace_depth,
+                    freeze=args.trace_freeze,
+                    trigger=args.trace_trigger,
+                )
+            except ValueError as ex:
+                ap.error(str(ex))
 
     if args.perf:
         # After attach_telemetry so perf.jsonl streams into the same sink

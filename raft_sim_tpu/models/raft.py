@@ -47,6 +47,19 @@ SURVEY.md section 2.3 and deliberately NOT carried):
 
 Everything is written for ONE cluster (shapes [N], [N, N], [N, CAP]); `jax.vmap` lifts
 to [batch, ...] and `lax.scan` (sim/scan.py) rolls ticks.
+
+TRACE DELTA CONTRACT (raft_sim_tpu/trace, cfg.track_trace): the protocol
+trace plane derives discrete events from this kernel's state DELTAS --
+role, term, voted_for, commit_index, log_len -- outside the kernel (one
+extractor serves both kernels and any step_fn override; zero step
+lowerings added). Two properties of the phase order above are load-bearing
+for the whole-history checker and must survive refactors: (1) a node that
+loses leadership and accepts entries in one tick changes `role` in the SAME
+tick as `log_len` (phase 1 adoption precedes phase 3 append -- the checker
+replays role changes before log changes), and (2) a win (phase 4) can never
+co-occur with an AE-accept truncation on the same node (a candidate that
+accepted a current-term AE stepped down in phase 3 and cannot win). See
+trace/events.py.
 """
 
 from __future__ import annotations

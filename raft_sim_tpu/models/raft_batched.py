@@ -55,7 +55,12 @@ def from_batch_minor(tree):
 def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
     """One tick for B clusters at once; every array carries a trailing batch axis.
 
-    Mirrors raft.step phase by phase; see that function for the reference citations.
+    Mirrors raft.step phase by phase; see that function for the reference
+    citations -- and for the TRACE DELTA CONTRACT (raft_sim_tpu/trace reads
+    role/term/voted_for/commit_index/log_len deltas of this kernel too; the
+    phase-order properties documented there bind both kernels, which
+    tests/test_trace.py pins by re-deriving the batched path's device events
+    from the unbatched kernel's stacked states).
     """
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active

@@ -98,6 +98,19 @@ def count(words: jax.Array, axis: int = -1) -> jax.Array:
     return jnp.sum(popcount(words).astype(jnp.int32), axis=_axis(axis, words.ndim))
 
 
+def np_popcount_u32(arr) -> "np.ndarray":
+    """Host-side per-word popcount of a uint32 ndarray (numpy-1.x compatible:
+    unpack the word bytes, sum the bits) -- the numpy counterpart of
+    `popcount` for consumers that fold exported packed planes on the host
+    (telemetry sink coverage rollups, the coverage-fitness search). Single
+    copy here so the two can never drift."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(arr, np.uint32))
+    bytes_ = a.view(np.uint8).reshape(a.shape + (4,))
+    return np.unpackbits(bytes_, axis=-1).sum(axis=-1, dtype=np.int64)
+
+
 def andnot(a: jax.Array, b: jax.Array) -> jax.Array:
     """a & ~b. Canonical whenever `a` is canonical (the ~ never escapes the &)."""
     return a & ~b

@@ -96,6 +96,20 @@ class SearchSpec:
     seed: int = 0
     init_sigma: float = 0.35
     min_sigma: float = 0.05
+    # Fitness mode. "scalar" (default): the hand-tuned distress weights
+    # below. "coverage": transition-coverage NOVELTY -- each cluster's
+    # fitness is the number of (role x event-kind) + (kind -> kind) coverage
+    # bits it sets that NO earlier evaluation in this search has seen
+    # (raft_sim_tpu/trace, ROADMAP item 5's coverage-guided seed), with
+    # violations still lexicographically dominant. Coverage mode runs the
+    # trace-variant windowed program -- ONE compiled program for the whole
+    # hunt (genomes are traced data; pinned by the analyzer's trace fork
+    # pairs), and the bitmap is deterministic for a fixed (genome, seed).
+    fitness: str = "scalar"
+    # Event-buffer depth of the coverage-mode trace program. Coverage only
+    # needs the bitmap, so a shallow buffer keeps the carry cheap; events
+    # past it are counted, not kept.
+    trace_depth: int = 32
     # CE smoothing toward the elite statistics (1.0 = classic full refit).
     # Each generation re-seeds the simulator, so fitness is NOISY; a full
     # refit lets one lucky generation yank the distribution off a promising
@@ -149,6 +163,29 @@ def fitness_from_records(records, metrics) -> np.ndarray:
     )
 
 
+def _popcount_words(words: np.ndarray) -> np.ndarray:
+    """Set bits along the leading word axis of a uint32 array -> per-cluster
+    counts ([C, B] -> [B]). The per-word popcount is the shared host helper
+    (ops/bitplane.np_popcount_u32) so this can never drift from the sink's
+    coverage rollup."""
+    from raft_sim_tpu.ops.bitplane import np_popcount_u32
+
+    return np_popcount_u32(words).sum(axis=0)
+
+
+def coverage_fitness(cov: np.ndarray, seen: np.ndarray, violations) -> tuple[np.ndarray, np.ndarray]:
+    """([B] fitness, updated seen) from a [C, B] per-cluster coverage bitmap
+    and the search's accumulated [C] seen-bit union. Novelty = bits this
+    cluster sets beyond everything seen BEFORE this generation (all clusters
+    of one generation score against the same baseline -- deterministic and
+    order-free); violations stay lexicographically dominant."""
+    cov = np.asarray(cov, np.uint32)
+    novel = cov & ~seen[:, None]
+    fit = W_VIOLATION * np.asarray(violations, np.float64) + _popcount_words(novel)
+    seen = seen | np.bitwise_or.reduce(cov, axis=1)
+    return fit, seen
+
+
 @dataclasses.dataclass
 class SearchResult:
     """Outcome of one search: per-generation log plus the first violating
@@ -178,6 +215,22 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
     knobs = spec.knobs or default_knobs(cfg)
     if spec.ticks % spec.window:
         raise ValueError(f"ticks {spec.ticks} must divide by window {spec.window}")
+    if spec.fitness not in ("scalar", "coverage"):
+        raise ValueError(f"unknown fitness mode {spec.fitness!r} "
+                         "(have: scalar, coverage)")
+    trace_spec = None
+    seen = None
+    if spec.fitness == "coverage":
+        import dataclasses as _dc
+
+        from raft_sim_tpu.trace.ring import COV_WORDS, TraceSpec
+
+        # The coverage hunt runs the trace-mode variant of cfg: same step
+        # kernels, one extra (pinned) windowed lowering -- every generation
+        # reuses it, exactly like the scalar mode's program.
+        cfg = _dc.replace(cfg, track_trace=True)
+        trace_spec = TraceSpec(depth=spec.trace_depth, coverage=True)
+        seen = np.zeros(COV_WORDS, np.uint32)
     rng = np.random.default_rng(spec.seed)
     dim = len(knobs)
     mu = np.full(dim, 0.5)
@@ -201,10 +254,17 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
         sim_seed = spec.seed + SEED_STRIDE * gen
         if perf is not None:
             perf.begin(spec.ticks)
-        _, metrics, records, _ = telemetry.simulate_windowed(
-            cfg, sim_seed, spec.population, spec.ticks, spec.window,
-            genome=g,
-        )
+        if trace_spec is None:
+            _, metrics, records, _ = telemetry.simulate_windowed(
+                cfg, sim_seed, spec.population, spec.ticks, spec.window,
+                genome=g,
+            )
+            tp = None
+        else:
+            _, metrics, records, _, _, tp = telemetry.simulate_windowed(
+                cfg, sim_seed, spec.population, spec.ticks, spec.window,
+                genome=g, trace=trace_spec,
+            )
         import jax
 
         if perf is not None:
@@ -215,7 +275,15 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
             perf.end(sync=lambda: np.asarray(metrics.ticks))
         metrics = jax.device_get(metrics)
         records = jax.device_get(records)
-        fit = fitness_from_records(records, metrics)
+        if trace_spec is None:
+            fit = fitness_from_records(records, metrics)
+            cov_new = None
+        else:
+            before = int(_popcount_words(seen[:, None])[0])
+            fit, seen = coverage_fitness(
+                np.asarray(tp.cov), seen, metrics.violations
+            )
+            cov_new = int(_popcount_words(seen[:, None])[0]) - before
         order = np.argsort(-fit)
         elites = xs[order[:n_elite]]
         a = spec.smoothing
@@ -228,14 +296,18 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
         viol = np.asarray(metrics.violations)
         violating = np.flatnonzero(viol > 0)
         best = int(order[0])
-        gens.append({
+        row = {
             "gen": gen,
             "seed": int(sim_seed),
             "best_fitness": float(fit[best]),
             "mean_fitness": float(fit.mean()),
             "violating_clusters": int(violating.size),
             "best_genome": genome_mod.decode(rows[best])[0],
-        })
+        }
+        if cov_new is not None:
+            row["cov_new_bits"] = cov_new
+            row["cov_total_bits"] = int(_popcount_words(seen[:, None])[0])
+        gens.append(row)
         if violating.size and hit is None:
             c = int(violating[0])
             fv = np.asarray(records.first_viol_tick)[c]
@@ -262,6 +334,7 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
             "window": spec.window,
             "elite_frac": spec.elite_frac,
             "seed": spec.seed,
+            "fitness": spec.fitness,
             "knobs": [dataclasses.asdict(k) for k in knobs],
         },
     )

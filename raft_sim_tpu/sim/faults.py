@@ -173,6 +173,52 @@ def genome_at(genome, now: jax.Array, seg_len: int):
     return jax.tree.map(lambda t: t[seg], genome)
 
 
+def _cut_count(n: int, k_part: jax.Array, now: jax.Array, period, part_t) -> jax.Array:
+    """Scalar int32: edges cut by the rolling partition at tick `now` (0 when
+    inactive or before tick 0 -- a phantom 'window -1' layout must not read
+    as a partition onset at tick 0)."""
+    cut = jnp.sum(_partition_cut(n, k_part, now, period, part_t)).astype(jnp.int32)
+    return jnp.where(now >= 0, cut, 0)
+
+
+def trace_fault_inputs(cfg: RaftConfig, key: jax.Array, now: jax.Array,
+                       genome=None, seg_len: int = 1):
+    """(crashed [N] bool, cut_now scalar int32, cut_prev scalar int32) -- the
+    fault-lattice facts event extraction (trace/events.py) needs that
+    StepInputs does not carry: the crash EDGE (down now, up last tick; the
+    mirror of `restarted`) and the partition cut-edge counts at `now` and
+    `now - 1` (their inequality is the partition-change event). Recomputed
+    from the SAME key streams and helpers as make_inputs, so the draws are
+    identical (XLA CSEs the shared subexpressions) and the facts can never
+    disagree with the inputs the kernel consumed. Genome path mirrors
+    make_inputs' segment convention: both liveness reads use the segment
+    active at `now` (docs/SCENARIOS.md)."""
+    n = cfg.n_nodes
+    _, _, k_part = jax.random.split(key, 3)
+    if genome is not None:
+        g = genome_at(genome, now, seg_len)
+        ckey = crash_key(key)
+        crashed = _alive_at_t(cfg, ckey, now - 1, g.crash, g.crash_down) & ~_alive_at_t(
+            cfg, ckey, now, g.crash, g.crash_down
+        )
+        cut_now = _cut_count(n, k_part, now, g.part_period, g.part)
+        cut_prev = _cut_count(n, k_part, now - 1, g.part_period, g.part)
+        return crashed, cut_now, cut_prev
+    if cfg.crash_prob > 0:
+        ckey = crash_key(key)
+        crashed = alive_at(cfg, ckey, now - 1) & ~alive_at(cfg, ckey, now)
+    else:
+        crashed = jnp.zeros((n,), bool)
+    if cfg.partition_period > 0:
+        part_t = jnp.uint32(p_to_u32(cfg.partition_prob))
+        cut_now = _cut_count(n, k_part, now, cfg.partition_period, part_t)
+        cut_prev = _cut_count(n, k_part, now - 1, cfg.partition_period, part_t)
+    else:
+        cut_now = jnp.int32(0)
+        cut_prev = jnp.int32(0)
+    return crashed, cut_now, cut_prev
+
+
 def make_inputs(
     cfg: RaftConfig,
     key: jax.Array,

@@ -236,7 +236,8 @@ def run_batch_minor(
 
 
 def tick_batch_minor(
-    cfg, s, keys, metrics, step_fn=None, client_cmd=None, genome=None, seg_len=1
+    cfg, s, keys, metrics, step_fn=None, client_cmd=None, genome=None, seg_len=1,
+    events=False,
 ):
     """ONE tick of the batch-minor path: input generation, step, metric
     accumulation. `s` is batch-minor; `keys` keep their [B]-leading layout (input
@@ -245,7 +246,14 @@ def tick_batch_minor(
     so the two can never drift. `client_cmd` overrides the scheduled client input
     for this tick. Returns (state, metrics, StepInfo) -- the per-tick info rides
     batch-minor ([B] scalars, [BINS, B] histogram); callers that only need the
-    carry drop it (XLA dead-code-eliminates the unused output)."""
+    carry drop it (XLA dead-code-eliminates the unused output).
+
+    `events=True` (the trace plane, cfg.track_trace) additionally extracts
+    this tick's protocol events from the state delta (trace/events.py) and
+    returns (state, metrics, StepInfo, TickEvents). Extraction is read-only
+    over values this body already computes plus the fault facts recomputed
+    from the same key streams (faults.trace_fault_inputs) -- the first three
+    return values are bit-identical either way (tests/test_trace.py)."""
     from raft_sim_tpu.models import raft_batched
 
     if step_fn is None:
@@ -265,7 +273,24 @@ def tick_batch_minor(
     inp_t = raft_batched.to_batch_minor(inp)
     s2, info = step_fn(cfg, s, inp_t)
     m2 = _accumulate(metrics, info, s.now)  # all fields [B]: elementwise
-    return (s2, m2, info)
+    if not events:
+        return (s2, m2, info)
+    from raft_sim_tpu.trace import events as tev
+
+    if genome is None:
+        crashed, cut_now, cut_prev = jax.vmap(
+            lambda k, now: faults.trace_fault_inputs(cfg, k, now)
+        )(keys, s.now)
+    else:
+        crashed, cut_now, cut_prev = jax.vmap(
+            lambda k, now, g: faults.trace_fault_inputs(
+                cfg, k, now, genome=g, seg_len=seg_len
+            )
+        )(keys, s.now, genome)
+    ev = tev.extract(
+        cfg, s, s2, inp_t, info, jnp.moveaxis(crashed, 0, -1), cut_now, cut_prev
+    )
+    return (s2, m2, info, ev)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))

@@ -113,10 +113,15 @@ def init_recorder(cfg: RaftConfig, k: int, batch: int) -> FlightRecorder:
     )
 
 
-def _record(rec: FlightRecorder, info: StepInfo, now: jax.Array, k: int) -> FlightRecorder:
+def _record(
+    rec: FlightRecorder, info: StepInfo, now: jax.Array, k: int, trig: jax.Array
+) -> FlightRecorder:
     """Write one tick's StepInfo into the ring (per-cluster slot pos % K),
-    gated on ~frozen; latch frozen AFTER the write so the violating tick is
-    the ring's newest entry."""
+    gated on ~frozen; latch frozen AFTER the write so the TRIGGERING tick is
+    the ring's newest entry. `trig` is the [B] freeze predicate -- any viol_*
+    flag by default, or "an event of the armed kind fired" when a trigger
+    kind is set (run_batch_minor_telemetry `trigger_kind`): the lead-up to a
+    non-violating anomaly is capturable, not only violations."""
     slot = rec.pos % k  # [B]
     write = ~rec.frozen  # [B]
     oh1 = (jnp.arange(k, dtype=jnp.int32)[:, None] == slot[None, :]) & write[None, :]
@@ -128,12 +133,11 @@ def _record(rec: FlightRecorder, info: StepInfo, now: jax.Array, k: int) -> Flig
         return jnp.where(oh, val[None], leaf)
 
     ring = StepInfo(*(upd(l, v) for l, v in zip(rec.ring, info)))
-    bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
     return FlightRecorder(
         ring=ring,
         tick=upd(rec.tick, now),
         pos=rec.pos + write,
-        frozen=rec.frozen | (write & bad),
+        frozen=rec.frozen | (write & trig),
     )
 
 
@@ -147,6 +151,9 @@ def run_batch_minor_telemetry(
     step_fn=None,
     genome=None,
     seg_len: int = 1,
+    trace_spec=None,
+    trace_persist=None,
+    trigger_kind: int | None = None,
 ):
     """`scan.run_batch_minor` with telemetry carry legs: same trajectories
     (bit-for-bit -- the tick body is shared), plus [n_ticks/window]
@@ -161,7 +168,27 @@ def run_batch_minor_telemetry(
     `init_recorder(...)` to start one, None to disable. State/keys/metrics/
     records use the public [B, ...]-leading convention at entry/exit.
 
-    Returns (final_state, metrics, records, recorder).
+    The PROTOCOL TRACE PLANE (raft_sim_tpu/trace; requires cfg.track_trace):
+
+      trace_spec     a trace.TraceSpec arms per-cluster event extraction +
+                     the window event buffer + the transition-coverage
+                     bitmap; the per-window exports ride a fifth/sixth
+                     return value. `trace_persist` threads the cross-window
+                     trace state between chunked calls (None starts fresh).
+      trigger_kind   an EV_* kind re-arms the flight recorder's freeze on
+                     the first occurrence of that event kind instead of the
+                     default viol_* trigger -- "capture the lead-up to the
+                     first leadership change/crash/...", the gap
+                     docs/OBSERVABILITY.md used to note.
+
+    With neither set, this function lowers EXACTLY as before -- no trace leg
+    exists in the program (the zero-cost-when-off contract config.track_trace
+    documents; tests/test_trace.py pins bit-exactness both ways).
+
+    Returns (final_state, metrics, records, recorder), plus
+    (trace_windows, trace_persist) appended when trace_spec is given --
+    trace_windows is a batch-minor stacked trace.TraceWindowOut (leaves
+    [n_windows, ..., B]), trace_persist the carried trace.TracePersist.
     """
     if n_ticks % window:
         raise ValueError(f"n_ticks {n_ticks} must divide by window {window}")
@@ -169,47 +196,110 @@ def run_batch_minor_telemetry(
         step_fn = raft_batched.step_b
     batch = state.role.shape[0]
     ring_k = 0 if recorder is None else recorder.tick.shape[0]
+    need_events = trace_spec is not None or trigger_kind is not None
+    if need_events and not cfg.track_trace:
+        raise ValueError(
+            "protocol tracing / event triggers need cfg.track_trace=True "
+            "(the zero-cost-when-off contract: untraced configs must compile "
+            "untraced programs -- utils/config.py)"
+        )
     s_t = raft_batched.to_batch_minor(state)
     m0 = raft_batched.to_batch_minor(scan.init_metrics_batch(batch))
 
-    def inner(carry, _):
-        s, wm, fv, rec = carry
-        now = s.now  # [B] absolute tick BEFORE the step (lockstep across B)
-        s2, wm2, info = scan.tick_batch_minor(
-            cfg, s, keys, wm, step_fn=step_fn, genome=genome, seg_len=seg_len
+    if not need_events:
+
+        def inner(carry, _):
+            s, wm, fv, rec = carry
+            now = s.now  # [B] absolute tick BEFORE the step (lockstep across B)
+            s2, wm2, info = scan.tick_batch_minor(
+                cfg, s, keys, wm, step_fn=step_fn, genome=genome, seg_len=seg_len
+            )
+            bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
+            fv2 = jnp.minimum(fv, jnp.where(bad, now, NEVER))
+            rec2 = _record(rec, info, now, ring_k, bad) if ring_k else rec
+            return (s2, wm2, fv2, rec2), None
+
+        def outer(carry, _):
+            s, m, rec = carry
+            start = s.now
+            fv0 = jnp.full((batch,), NEVER, jnp.int32)
+            (s2, wm, fv, rec2), _ = lax.scan(
+                inner, (s, m0, fv0, rec), None, length=window
+            )
+            out = WindowRecord(start=start, first_viol_tick=fv, metrics=wm)
+            return (s2, merge_metrics(m, wm), rec2), out
+
+        (final_t, metrics, rec_t), recs = lax.scan(
+            outer, (s_t, m0, recorder), None, length=n_ticks // window
+        )
+        # Records stack [n_windows, ..., B]: one batch-axis move yields the
+        # public [B, n_windows, ...] layout (per-cluster leading).
+        return (
+            raft_batched.from_batch_minor(final_t),
+            raft_batched.from_batch_minor(metrics),
+            raft_batched.from_batch_minor(recs),
+            rec_t,
+        )
+
+    from raft_sim_tpu.trace import events as tev
+    from raft_sim_tpu.trace import ring as tring
+
+    if trace_spec is not None and trace_persist is None:
+        trace_persist = tring.init_persist(trace_spec, batch)
+    tp0 = trace_persist if trace_spec is not None else ()
+
+    def inner_t(carry, _):
+        s, wm, fv, rec, tw, tp = carry
+        now = s.now
+        s2, wm2, info, ev = scan.tick_batch_minor(
+            cfg, s, keys, wm, step_fn=step_fn, genome=genome, seg_len=seg_len,
+            events=True,
         )
         bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
         fv2 = jnp.minimum(fv, jnp.where(bad, now, NEVER))
-        rec2 = _record(rec, info, now, ring_k) if ring_k else rec
-        return (s2, wm2, fv2, rec2), None
+        trig = bad if trigger_kind is None else tev.any_of_kind(cfg, ev, trigger_kind)
+        rec2 = _record(rec, info, now, ring_k, trig) if ring_k else rec
+        if trace_spec is not None:
+            tw, tp = tring.record(cfg, trace_spec, tw, tp, ev, now)
+        return (s2, wm2, fv2, rec2, tw, tp), None
 
-    def outer(carry, _):
-        s, m, rec = carry
+    def outer_t(carry, _):
+        s, m, rec, tp = carry
         start = s.now
         fv0 = jnp.full((batch,), NEVER, jnp.int32)
-        (s2, wm, fv, rec2), _ = lax.scan(
-            inner, (s, m0, fv0, rec), None, length=window
+        tw0 = tring.init_window(trace_spec, batch) if trace_spec is not None else ()
+        (s2, wm, fv, rec2, tw, tp2), _ = lax.scan(
+            inner_t, (s, m0, fv0, rec, tw0, tp), None, length=window
         )
-        out = WindowRecord(start=start, first_viol_tick=fv, metrics=wm)
-        return (s2, merge_metrics(m, wm), rec2), out
+        rec_out = WindowRecord(start=start, first_viol_tick=fv, metrics=wm)
+        out = (
+            (rec_out, tring.TraceWindowOut(win=tw, cov=tp2.cov))
+            if trace_spec is not None
+            else rec_out
+        )
+        return (s2, merge_metrics(m, wm), rec2, tp2), out
 
-    (final_t, metrics, rec_t), recs = lax.scan(
-        outer, (s_t, m0, recorder), None, length=n_ticks // window
+    (final_t, metrics, rec_t, tp_final), outs = lax.scan(
+        outer_t, (s_t, m0, recorder, tp0), None, length=n_ticks // window
     )
-    # Records stack [n_windows, ..., B]: one batch-axis move yields the public
-    # [B, n_windows, ...] layout (per-cluster leading, like everything else).
-    return (
+    recs, traws = outs if trace_spec is not None else (outs, None)
+    base = (
         raft_batched.from_batch_minor(final_t),
         raft_batched.from_batch_minor(metrics),
         raft_batched.from_batch_minor(recs),
         rec_t,
     )
+    if trace_spec is None:
+        return base
+    # Trace exports stay batch-minor (leaves [n_windows, ..., B]): the sink /
+    # history builder consume them host-side per window, like the recorder.
+    return base + (traws, tp_final)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 5, 7))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 5, 7, 8, 9))
 def simulate_windowed(
     cfg: RaftConfig, seed, batch: int, n_ticks: int, window: int, ring: int = 0,
-    genome=None, seg_len: int = 1,
+    genome=None, seg_len: int = 1, trace=None, trigger_kind: int | None = None,
 ):
     """`scan.simulate` with telemetry: one-call batched init + windowed scan.
     Returns (final_state, metrics, records, recorder) -- metrics/trajectories
@@ -217,7 +307,11 @@ def simulate_windowed(
     `ring` > 0 enables the flight recorder at that depth. `genome` ([B, S]
     rows, traced) selects the scenario path: the search loop evaluates a whole
     genome population in THIS one device call, and new genome values reuse the
-    compiled program (only a new S/seg_len recompiles)."""
+    compiled program (only a new S/seg_len recompiles). `trace` (a static
+    trace.TraceSpec; requires cfg.track_trace) arms the protocol trace plane
+    and appends (trace_windows, trace_persist) to the return -- the coverage
+    search's per-generation call; `trigger_kind` re-arms the flight
+    recorder's freeze on an event kind (run_batch_minor_telemetry)."""
     root = jax.random.key(seed)
     k_init, k_run = jax.random.split(root)
     from raft_sim_tpu.types import init_batch
@@ -226,7 +320,26 @@ def simulate_windowed(
     keys = jax.random.split(k_run, batch)
     rec = init_recorder(cfg, ring, batch) if ring else None
     return run_batch_minor_telemetry(
-        cfg, state, keys, n_ticks, window, rec, genome=genome, seg_len=seg_len
+        cfg, state, keys, n_ticks, window, rec, genome=genome, seg_len=seg_len,
+        trace_spec=trace, trigger_kind=trigger_kind,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6, 8, 9, 11), donate_argnums=(1,))
+def _chunk_t_donate_trace(cfg, state, keys, rec, n, window, ring_k, genome=None,
+                          seg_len=1, trace_spec=None, trace_persist=None,
+                          trigger_kind=None):
+    """The traced-soak chunk: `_chunk_t_donate` plus the trace-plane legs
+    (separate entry point so the UNTRACED soak program and its donation pin
+    stay byte-identical to pre-trace builds). Same donation contract: the
+    fleet carry is donated chunk-to-chunk; the trace persist legs are small
+    ([B]-scalars + COV_WORDS words) and threaded un-donated like the
+    recorder."""
+    recorder = rec if ring_k else None
+    return run_batch_minor_telemetry(
+        cfg, state, keys, n, window, recorder, genome=genome, seg_len=seg_len,
+        trace_spec=trace_spec, trace_persist=trace_persist,
+        trigger_kind=trigger_kind,
     )
 
 
@@ -256,6 +369,10 @@ def run_chunked_telemetry(
     genome=None,
     seg_len: int = 1,
     perf=None,
+    trace_spec=None,
+    trace_persist=None,
+    trigger_kind: int | None = None,
+    trace_callback=None,
 ):
     """Long-horizon telemetry runs: the `chunked.run_chunked` analogue with
     window records offloaded to the host between chunks (so a 10M-tick soak
@@ -270,7 +387,16 @@ def run_chunked_telemetry(
     self-describing: `metrics.ticks` carries each window's true width).
     `callback(ticks_done, state, merged_metrics, records)` receives each
     chunk's records in the public [B, n_windows, ...] layout; returning True
-    stops early. Returns (final_state, merged_metrics, recorder).
+    stops early. Returns (final_state, merged_metrics, recorder) -- with
+    `trace_persist` appended when `trace_spec` is given.
+
+    The trace plane (trace_spec / trace_persist / trigger_kind: see
+    run_batch_minor_telemetry) streams like the window records: each chunk's
+    stacked TraceWindowOut (batch-minor) is handed to
+    `trace_callback(ticks_done, trace_windows)` -- the sink's
+    `append_trace` -- and the cross-window trace state threads chunk to
+    chunk. Untraced calls run the IDENTICAL `_chunk_t_donate` program as
+    before (the traced soak is its own pinned entry point).
 
     Buffer ownership matches `chunked.run_chunked`: the caller's `state` stays
     valid (one up-front copy, owned by the loop), each chunk's state is
@@ -279,12 +405,18 @@ def run_chunked_telemetry(
     """
     batch = state.role.shape[0]
     ring_k = 0 if recorder is None else recorder.tick.shape[0]
+    need_events = trace_spec is not None or trigger_kind is not None
     win_per_chunk = max(1, chunk // window)
     metrics = scan.init_metrics_batch(batch)
     done = 0
     state = _own_copy(state)
+    if trace_spec is not None and trace_persist is None:
+        from raft_sim_tpu.trace import ring as tring
+
+        trace_persist = tring.init_persist(trace_spec, batch)
     if perf is not None:
-        perf.add_probe("telemetry._chunk_t_donate", _chunk_t_donate)
+        probe = _chunk_t_donate_trace if need_events else _chunk_t_donate
+        perf.add_probe("telemetry._chunk_t_donate", probe)
     while done < n_ticks:
         left = n_ticks - done
         if left >= window:
@@ -294,20 +426,36 @@ def run_chunked_telemetry(
             n = w = left  # remainder: one final short window
         if perf is not None:
             perf.begin(n)
-        state, m, recs, recorder = _chunk_t_donate(
-            cfg, state, keys, recorder, n, w, ring_k, genome, seg_len
-        )
+        if need_events:
+            out = _chunk_t_donate_trace(
+                cfg, state, keys, recorder, n, w, ring_k, genome, seg_len,
+                trace_spec, trace_persist, trigger_kind,
+            )
+            if trace_spec is not None:
+                state, m, recs, recorder, traws, trace_persist = out
+            else:
+                state, m, recs, recorder = out
+                traws = None
+        else:
+            state, m, recs, recorder = _chunk_t_donate(
+                cfg, state, keys, recorder, n, w, ring_k, genome, seg_len
+            )
+            traws = None
         if perf is not None:
             perf.dispatched()
         metrics = merge_metrics(metrics, m)
         done += n
         # The callback's window export (sink append, apply-log update) is
         # this chunk's host gap; close after it, synced on the chunk metrics.
+        if traws is not None and trace_callback is not None:
+            trace_callback(done, traws)
         stop = callback is not None and callback(done, state, metrics, recs)
         if perf is not None:
             perf.end(sync=lambda: np.asarray(m.ticks))
         if stop:
             break
+    if trace_spec is not None:
+        return state, metrics, recorder, trace_persist
     return state, metrics, recorder
 
 

@@ -1,0 +1,275 @@
+"""Whole-history checker: the five Raft safety properties over a complete run.
+
+The per-tick `viol_*` flags (models/raft.py phase 9) check each property's
+INSTANTANEOUS form -- two leaders this tick, a mutated prefix this tick. The
+Raft paper states them as HISTORY claims (fig. 3), and some violations only
+exist as history: two leaders elected for one term three windows apart never
+coexist on any tick. This module replays a reconstructed History
+(trace/history.py) through a per-cluster state machine and verifies:
+
+  election_safety        at most one leader ELECTED per term across the whole
+                         run (pure history: the EV_LEADER events; witness =
+                         the two conflicting leader events).
+  leader_append_only     a node never truncates its log while it holds
+                         leadership (pure history: EV_TRUNCATE between a
+                         node's EV_LEADER and its role loss).
+  leader_completeness    the cluster's committed frontier (max commit index
+                         ever witnessed) is never re-committed-below by a
+                         LEADER: a correct leader's commit advance only lands
+                         on current-term entries, which sit strictly above
+                         everything committed before its election -- a
+                         leader commit below the frontier means its log was
+                         missing committed entries. Followers legally trail
+                         the frontier; only leader-attributed commits count.
+  state_machine_safety   per-node commit indices are monotone except across a
+                         restart (commit legally resumes from the durable
+                         snapshot base), plus the device-side committed-
+                         prefix-immutability flag (EV_VIOLATION commit bit --
+                         index monotonicity alone cannot see a same-index
+                         CONTENT change; the kernel's carried checksum can).
+  log_matching           device-backed: the kernel's O(N^2 CAP) cross-node
+                         prefix comparison runs on device (EV_VIOLATION
+                         log-matching bit); the history carries its verdicts.
+                         Content never leaves the device, so this property is
+                         honest about being flag-backed, not re-derived.
+
+  The within-tick event order events.py defines is load-bearing here: role
+  transitions precede commit/append/truncate kinds, so "stepped down then
+  truncated in one tick" replays in kernel phase order.
+
+A history with holes (ring overflow, truncated or reordered trace.jsonl)
+can still FAIL -- a witnessed violation is a violation -- but can never PASS:
+undecided properties report ok=None with an incomplete-history note
+(tests/test_trace.py pins both directions).
+
+CLI: `python -m raft_sim_tpu.trace.checker <telemetry dir> [--json]`
+exit 0 = all five hold, 1 = a named property is violated (witness printed),
+2 = incomplete history and no violation found.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from raft_sim_tpu.trace import events as tev
+from raft_sim_tpu.trace.history import Event, History
+
+PROPERTIES = (
+    "election_safety",
+    "leader_append_only",
+    "log_matching",
+    "leader_completeness",
+    "state_machine_safety",
+)
+
+
+@dataclasses.dataclass
+class PropertyResult:
+    name: str
+    ok: bool | None  # None = undecidable (incomplete history, no witness)
+    witness: list[dict]  # minimal witnessing events (empty when ok)
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CheckReport:
+    results: dict[str, PropertyResult]
+    complete: bool
+    problems: list[str]
+    clusters: int
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results.values())
+
+    @property
+    def violated(self) -> list[str]:
+        return [n for n, r in self.results.items() if r.ok is False]
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "complete": self.complete,
+            "violated": self.violated,
+            "problems": self.problems,
+            "clusters": self.clusters,
+            "properties": {n: r.to_dict() for n, r in self.results.items()},
+        }
+
+
+def _check_cluster(c: int, evs: list[Event], fail) -> None:
+    """Replay one cluster's timeline; report violations via fail(prop,
+    witness_events, note)."""
+    leaders_by_term: dict[int, Event] = {}
+    leader_set: dict[int, Event] = {}  # node -> its EV_LEADER event
+    frontier = 0
+    frontier_ev: Event | None = None
+    last_commit: dict[int, tuple[int, Event]] = {}
+    restarted_since: dict[int, bool] = {}
+    for e in evs:
+        k = e.kind
+        if k in (tev.EV_FOLLOWER, tev.EV_PRECANDIDATE, tev.EV_CANDIDATE):
+            leader_set.pop(e.node, None)
+        elif k == tev.EV_LEADER:
+            term = e.detail
+            prior = leaders_by_term.get(term)
+            if prior is not None:
+                fail(
+                    "election_safety", [prior, e],
+                    f"cluster {c}: two leaders elected for term {term} "
+                    f"(node {prior.node} at tick {prior.tick}, node {e.node} "
+                    f"at tick {e.tick})",
+                )
+            else:
+                leaders_by_term[term] = e
+            leader_set[e.node] = e
+        elif k == tev.EV_TRUNCATE:
+            led = leader_set.get(e.node)
+            if led is not None:
+                fail(
+                    "leader_append_only", [led, e],
+                    f"cluster {c}: node {e.node} truncated its log to "
+                    f"{e.detail} at tick {e.tick} while leader (elected tick "
+                    f"{led.tick}, term {led.detail})",
+                )
+        elif k == tev.EV_COMMIT:
+            if e.node in leader_set and e.detail < frontier:
+                fw = [frontier_ev, e] if frontier_ev else [e]
+                fail(
+                    "leader_completeness", fw,
+                    f"cluster {c}: leader node {e.node} committed index "
+                    f"{e.detail} at tick {e.tick} below the committed "
+                    f"frontier {frontier}: its log was missing committed "
+                    "entries at election",
+                )
+            prev = last_commit.get(e.node)
+            if (
+                prev is not None
+                and e.detail < prev[0]
+                and not restarted_since.get(e.node, False)
+            ):
+                fail(
+                    "state_machine_safety", [prev[1], e],
+                    f"cluster {c}: node {e.node} commit index regressed "
+                    f"{prev[0]} -> {e.detail} without an intervening restart",
+                )
+            last_commit[e.node] = (e.detail, e)
+            restarted_since[e.node] = False
+            if e.detail > frontier:
+                frontier, frontier_ev = e.detail, e
+        elif k == tev.EV_RESTART:
+            restarted_since[e.node] = True
+            leader_set.pop(e.node, None)  # restart wipes role (defensive:
+            # the same-tick EV_FOLLOWER, ordered first, already removed it)
+        elif k == tev.EV_VIOLATION:
+            if e.detail & tev.VIOL_LOG_MATCHING:
+                fail(
+                    "log_matching", [e],
+                    f"cluster {c}: device log-matching check failed at tick "
+                    f"{e.tick} (cross-node committed prefixes disagree)",
+                )
+            if e.detail & tev.VIOL_COMMIT:
+                fail(
+                    "state_machine_safety", [e],
+                    f"cluster {c}: device commit invariant failed at tick "
+                    f"{e.tick} (committed prefix mutated or commit left "
+                    "bounds -- the carried checksum check)",
+                )
+            if e.detail & tev.VIOL_ELECTION:
+                # Per-tick concurrent same-term leaders: normally the two
+                # EV_LEADER events already witnessed this; keep the flag as
+                # the fallback witness (e.g. when one election predates a
+                # partial history's first window).
+                fail(
+                    "election_safety", [e],
+                    f"cluster {c}: device election-safety flag at tick "
+                    f"{e.tick} (two same-term leaders coexist)",
+                )
+
+
+def check_history(hist: History) -> CheckReport:
+    """Run all five property checks over every cluster's timeline."""
+    results = {p: PropertyResult(p, True, []) for p in PROPERTIES}
+
+    def fail(prop: str, witness: list[Event], note: str, cluster: int = -1):
+        r = results[prop]
+        if r.ok is False:
+            return  # first witness per property is the minimal report
+        r.ok = False
+        r.witness = [w.to_dict(cluster if cluster >= 0 else None) for w in witness]
+        r.note = note
+
+    for c in sorted(hist.events):
+        _check_cluster(
+            c, hist.events[c],
+            lambda prop, w, note, _c=c: fail(prop, w, note, _c),
+        )
+    if not hist.complete:
+        gaps = hist.incomplete_clusters()
+        parts = []
+        if gaps:
+            parts.append(f"events dropped in clusters {gaps[:8]}")
+        if hist.freeze_armed:
+            parts.append(
+                "recording freeze-truncated by design (freeze_kind armed: "
+                "a capture-economy prefix, not a whole-run history)"
+            )
+        parts.extend(hist.problems[:4])
+        note = "incomplete history: " + "; ".join(parts)
+        for r in results.values():
+            if r.ok is True:  # a found violation stands; a pass demotes
+                r.ok = None
+                r.note = note
+    return CheckReport(
+        results=results,
+        complete=hist.complete,
+        problems=list(hist.problems),
+        clusters=len(hist.events),
+    )
+
+
+def check_directory(directory: str) -> CheckReport:
+    from raft_sim_tpu.trace import history as hmod
+
+    return check_history(hmod.load(directory))
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="raft_sim_tpu.trace.checker", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("directory", help="telemetry sink dir with trace.jsonl")
+    ap.add_argument("--json", action="store_true", help="machine-readable report")
+    args = ap.parse_args(argv)
+    rep = check_directory(args.directory)
+    if args.json:
+        print(json.dumps(rep.to_dict(), indent=1))
+    else:
+        for name in PROPERTIES:
+            r = rep.results[name]
+            verdict = {True: "ok", False: "VIOLATED", None: "undecided"}[r.ok]
+            line = f"{name:<22} {verdict}"
+            if r.note:
+                line += f"  ({r.note})"
+            print(line)
+            for w in r.witness:
+                print(f"    witness: {w}")
+        if not rep.complete:
+            print(f"history INCOMPLETE: {'; '.join(rep.problems[:6]) or 'events dropped'}")
+    if rep.violated:
+        return 1
+    if not rep.complete or not rep.ok:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""Device-side protocol event extraction: state deltas -> a compact event stream.
+
+The reference's whole observability story is a println of node + message per
+loop iteration (core.clj:182-186); `sim/trace.py` already diffs HOST-side
+state stacks into events, but stacking full states is exactly what a 100k-
+cluster fleet cannot afford. This module computes the same discrete events ON
+DEVICE, from the (old state, new state, inputs, StepInfo) the tick body
+already holds -- reads only, zero trajectory perturbation -- so histories
+stream out of the windowed telemetry scan at ring-buffer cost instead of
+full-trajectory cost.
+
+Vocabulary (KINDS): one small-int code per event kind, with (tick, node,
+kind, detail) fields per emitted event. The WITHIN-TICK ordering is
+(kind, node) lexicographic over the static slot table below, and the kind
+NUMBERING is load-bearing for the checker: role-transition kinds come before
+commit/append/truncate kinds so that a node which loses leadership and
+accepts entries in the same tick is processed as "stepped down, then
+truncated" -- matching the kernel's phase order (models/raft.py phase 1
+adoption precedes phase 3 append) -- and fault kinds come last. The checker
+(trace/checker.py) replays events in exactly this order.
+
+Extraction is delta-based on purpose: both kernels (models/raft.py and
+models/raft_batched.py) produce the same ClusterState leaves, so ONE
+extractor serves both (and any step_fn override, e.g. the weak-quorum test
+mutant) without either kernel changing. The leaves read here -- role, term,
+voted_for, commit_index, log_len -- are the delta contract the kernels
+document; everything is elementwise over the node axis, so the same code
+runs on single-cluster [N] leaves and batch-minor [N, B] leaves.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_sim_tpu.ops import bitplane
+from raft_sim_tpu.types import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    NIL,
+    PRECANDIDATE,
+    ClusterState,
+    StepInfo,
+    StepInputs,
+)
+from raft_sim_tpu.utils.config import RaftConfig
+
+# Event kinds. 0 is reserved for "empty ring slot"; the numbering encodes the
+# within-tick processing order (module docstring). detail semantics per kind:
+#   role kinds      new term
+#   term            new term
+#   vote            candidate voted for
+#   commit          new commit index        append/truncate  new log length
+#   crash/restart   0                       drop             dropped in-edges
+#   violation       bitmask: 1 election-safety, 2 commit, 4 log-matching
+#   partition       cut-edge count after the change (0 = healed)
+EV_NONE = 0
+EV_FOLLOWER = 1
+EV_PRECANDIDATE = 2
+EV_CANDIDATE = 3
+EV_LEADER = 4
+EV_TERM = 5
+EV_VOTE = 6
+EV_COMMIT = 7
+EV_APPEND = 8
+EV_TRUNCATE = 9
+EV_CRASH = 10
+EV_RESTART = 11
+EV_DROP = 12
+EV_VIOLATION = 13
+EV_PARTITION = 14
+N_KINDS = 15
+
+KINDS = {
+    "follower": EV_FOLLOWER,
+    "precandidate": EV_PRECANDIDATE,
+    "candidate": EV_CANDIDATE,
+    "leader": EV_LEADER,
+    "term": EV_TERM,
+    "vote": EV_VOTE,
+    "commit": EV_COMMIT,
+    "append": EV_APPEND,
+    "truncate": EV_TRUNCATE,
+    "crash": EV_CRASH,
+    "restart": EV_RESTART,
+    "drop": EV_DROP,
+    "violation": EV_VIOLATION,
+    "partition": EV_PARTITION,
+}
+KIND_NAMES = {v: k for k, v in KINDS.items()}
+
+# Per-NODE kinds in slot order; the two cluster-scope kinds follow them with
+# node = NIL. Slot m's (node, kind) pair is a compile-time constant -- only
+# the flag and detail are data.
+PER_NODE_KINDS = (
+    EV_FOLLOWER, EV_PRECANDIDATE, EV_CANDIDATE, EV_LEADER, EV_TERM, EV_VOTE,
+    EV_COMMIT, EV_APPEND, EV_TRUNCATE, EV_CRASH, EV_RESTART, EV_DROP,
+)
+CLUSTER_KINDS = (EV_VIOLATION, EV_PARTITION)
+
+# Violation bitmask bits (EV_VIOLATION detail).
+VIOL_ELECTION = 1
+VIOL_COMMIT = 2
+VIOL_LOG_MATCHING = 4
+
+# Coverage role axis: the four node roles plus a fifth row for cluster-scope
+# events (trace/ring.py's role x kind bitmap).
+ROLE_DIM = 5
+ROLE_CLUSTER = 4
+assert {FOLLOWER, CANDIDATE, LEADER, PRECANDIDATE} == {0, 1, 2, 3}
+
+
+def n_slots(n: int) -> int:
+    """Candidate event slots per cluster per tick (static given N)."""
+    return n * len(PER_NODE_KINDS) + len(CLUSTER_KINDS)
+
+
+def slot_nodes(n: int) -> np.ndarray:
+    """[M] int32 node id per slot (NIL for cluster-scope slots); static."""
+    per_node = np.tile(np.arange(n, dtype=np.int32), len(PER_NODE_KINDS))
+    return np.concatenate([per_node, np.full(len(CLUSTER_KINDS), NIL, np.int32)])
+
+
+def slot_kinds(n: int) -> np.ndarray:
+    """[M] int32 event kind per slot; static. Kind-major layout: slot order
+    IS the within-tick event order (module docstring)."""
+    per_node = np.repeat(np.asarray(PER_NODE_KINDS, np.int32), n)
+    return np.concatenate([per_node, np.asarray(CLUSTER_KINDS, np.int32)])
+
+
+class TickEvents(NamedTuple):
+    """One tick's candidate events over the static slot table: `flags[m]` is
+    whether slot m's (node, kind) event occurred, `detail[m]` its payload and
+    `role[m]` the emitting node's role AFTER the tick (ROLE_CLUSTER for
+    cluster-scope slots) -- the coverage bitmap's role axis. Leaves are [M]
+    single-cluster or [M, B] batch-minor."""
+
+    flags: jax.Array  # [M(, B)] bool
+    detail: jax.Array  # [M(, B)] int32
+    role: jax.Array  # [M(, B)] int32 in [0, ROLE_DIM)
+
+
+def _bc(x, like):
+    """Broadcast a per-cluster scalar ([],[B]) to one slot row ([1],[1, B])."""
+    return jnp.broadcast_to(jnp.asarray(x), like.shape[1:])[None]
+
+
+def extract(
+    cfg: RaftConfig,
+    old: ClusterState,
+    new: ClusterState,
+    inp: StepInputs,
+    info: StepInfo,
+    crashed: jax.Array,
+    cut_now: jax.Array,
+    cut_prev: jax.Array,
+) -> TickEvents:
+    """Derive this tick's events from the state delta (old -> new), the tick
+    inputs, and the kernel's StepInfo. `crashed`/`cut_now`/`cut_prev` are the
+    fault-lattice facts StepInputs does not carry (faults.trace_fault_inputs:
+    the crash edge and the partition cut-edge counts at now and now - 1,
+    recomputed from the same key streams as make_inputs). All-integer and
+    elementwise over the node axis: works on [N] and [N, B] leaves alike."""
+    n = cfg.n_nodes
+    z32 = jnp.zeros_like(new.term)
+
+    def became(role_code):
+        return (new.role == role_code) & (old.role != role_code)
+
+    # Incoming-drop count per receiver: popcount of the packed delivery row
+    # (diagonal self-bit included in the mask, so delivered <= n).
+    delivered = bitplane.count(inp.deliver_mask, axis=1)  # [N(, B)]
+    dropped = jnp.int32(n) - delivered
+    burst = dropped >= max(1, (n + 1) // 2)
+
+    # Per-node (flag, detail) blocks, in PER_NODE_KINDS order.
+    blocks = (
+        (became(FOLLOWER), new.term),
+        (became(PRECANDIDATE), new.term),
+        (became(CANDIDATE), new.term),
+        (became(LEADER), new.term),
+        (new.term > old.term, new.term),
+        ((new.voted_for != old.voted_for) & (new.voted_for != NIL), new.voted_for),
+        (new.commit_index > old.commit_index, new.commit_index),
+        (new.log_len > old.log_len, new.log_len),
+        (new.log_len < old.log_len, new.log_len),
+        (crashed, z32),
+        (inp.restarted, z32),
+        (burst, dropped),
+    )
+    viol_mask = (
+        info.viol_election_safety * VIOL_ELECTION
+        + info.viol_commit * VIOL_COMMIT
+        + info.viol_log_matching * VIOL_LOG_MATCHING
+    ).astype(jnp.int32)
+    like = new.term[:1]  # [1(, B)] template for cluster rows
+    cluster = (
+        (_bc(viol_mask != 0, like), _bc(viol_mask, like)),
+        (_bc(cut_now != cut_prev, like), _bc(cut_now, like)),
+    )
+    flags = jnp.concatenate([f for f, _ in blocks] + [f for f, _ in cluster])
+    detail = jnp.concatenate(
+        [jnp.broadcast_to(d, f.shape).astype(jnp.int32) for f, d in blocks]
+        + [jnp.broadcast_to(d, f.shape).astype(jnp.int32) for f, d in cluster]
+    )
+    role_rows = jnp.concatenate(
+        [new.role for _ in PER_NODE_KINDS]
+        + [_bc(jnp.int32(ROLE_CLUSTER), like) for _ in CLUSTER_KINDS]
+    ).astype(jnp.int32)
+    return TickEvents(flags=flags, detail=detail, role=role_rows)
+
+
+def any_of_kind(cfg: RaftConfig, ev: TickEvents, kind: int) -> jax.Array:
+    """Per-cluster bool: any event of `kind` fired this tick -- the
+    flight-recorder / trace freeze trigger predicate (slot kinds are static,
+    so this is a static row-select + any-reduce)."""
+    sel = slot_kinds(cfg.n_nodes) == kind  # static [M]
+    idx = np.flatnonzero(sel)
+    return jnp.any(ev.flags[idx], axis=0)

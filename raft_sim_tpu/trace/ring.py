@@ -1,0 +1,230 @@
+"""The device-side trace ring + transition-coverage bitmap (scan-carry legs).
+
+Generalizes `sim/telemetry.py`'s violation-frozen flight recorder into an
+always-recordable, trigger-armable event stream: where the flight recorder
+keeps the last K ticks of StepInfo and freezes at the first violation, the
+trace ring keeps up to `depth` discrete EVENTS (trace/events.py) per cluster
+per telemetry window, exports them every window (so the full history streams
+out at bounded device cost), and can optionally stop recording after the
+first occurrence of a chosen event kind (`freeze_kind` -- the economy knob
+for "capture through the first X, then stop").
+
+Overflow clamps rather than wraps: a window emits its FIRST `depth` events in
+order and counts the rest as dropped (`TraceWin.n` is the emitted total, so
+dropped = n - min(n, depth)). Clamping keeps every exported window a strict
+history PREFIX -- the checker can flag the gap precisely instead of reasoning
+about a wrapped tail -- and the sizing is priced by the cost model like every
+other carry leg (docs/OBSERVABILITY.md "Protocol traces").
+
+The coverage plane is a packed bitmap (ops/bitplane words) over two blocks:
+
+  role x kind    bit r * N_KINDS + k: an event of kind k was emitted by a
+                 node in role r (ROLE_CLUSTER for cluster-scope events).
+  kind -> kind   bit BASE + p * N_KINDS + k: an event of kind k directly
+                 followed one of kind p in this cluster's stream (within-tick
+                 order = slot order; the previous window's last kind seeds
+                 the first adjacency of a window, so coverage is exact across
+                 window cuts).
+
+It is OR-folded in the telemetry window carry and exported cumulatively per
+window -- the novelty signal `scenario/search.py --fitness=coverage` hunts
+with (ROADMAP item 5's seed).
+
+Everything here is batch-minor ([..., B] trailing) and integer-only: the
+extraction feeding it reads state deltas, so recording can never perturb the
+trajectory it observes (pinned in tests/test_trace.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_sim_tpu.ops import bitplane
+from raft_sim_tpu.trace import events as tev
+from raft_sim_tpu.utils.config import RaftConfig
+
+# Coverage bit layout (module docstring): role-x-kind block, then adjacency.
+ROLE_KIND_BITS = tev.ROLE_DIM * tev.N_KINDS
+ADJ_BASE = ROLE_KIND_BITS
+COV_BITS = ROLE_KIND_BITS + tev.N_KINDS * tev.N_KINDS
+COV_WORDS = bitplane.n_words(COV_BITS)
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """Static trace-plane parameters (hashable -> a static jit argument).
+
+    depth        events retained per cluster per telemetry window; overflow
+                 is counted, never silently lost. Size so that a window's
+                 expected event volume fits (docs/OBSERVABILITY.md prices it:
+                 4 int32 planes of `depth` words per cluster in the carry).
+    coverage     fold the transition-coverage bitmap (COV_WORDS uint32 per
+                 cluster in the carry).
+    freeze_kind  EV_NONE (0) records forever; an EV_* kind stops a cluster's
+                 recording after the tick that first emits that kind
+                 (inclusive) -- the trace-side analogue of the flight
+                 recorder's trigger (sim/telemetry.py `trigger_kind`).
+    """
+
+    depth: int = 128
+    coverage: bool = True
+    freeze_kind: int = 0
+
+    def __post_init__(self):
+        assert self.depth >= 1
+        assert 0 <= self.freeze_kind < tev.N_KINDS
+
+
+class TraceWin(NamedTuple):
+    """One window's event buffer for every cluster (batch-minor carry leg;
+    reset each window and emitted as the window's trace export). Slot i of
+    ev_* holds the window's i-th event; EV_NONE kind = empty slot."""
+
+    ev_tick: jax.Array  # [R, B] int32 absolute tick
+    ev_node: jax.Array  # [R, B] int32 node id (NIL = cluster-scope)
+    ev_kind: jax.Array  # [R, B] int32 (EV_*; EV_NONE = empty)
+    ev_detail: jax.Array  # [R, B] int32
+    n: jax.Array  # [B] int32 events EMITTED this window (may exceed R)
+
+
+class TracePersist(NamedTuple):
+    """Trace state carried ACROSS windows (batch-minor)."""
+
+    frozen: jax.Array  # [B] bool: freeze_kind latched (recording stopped)
+    last_kind: jax.Array  # [B] int32: the stream's previous event kind
+    cov: jax.Array  # [COV_WORDS, B] uint32 cumulative coverage bitmap
+    total: jax.Array  # [B] int32 events emitted over the whole run
+
+
+class TraceWindowOut(NamedTuple):
+    """Per-window trace export: the window's event buffer plus the cumulative
+    coverage snapshot at window end (monotone across windows)."""
+
+    win: TraceWin
+    cov: jax.Array  # [COV_WORDS, B] uint32
+
+
+def init_window(spec: TraceSpec, batch: int) -> TraceWin:
+    r = spec.depth
+    z = lambda *s: jnp.zeros((*s, batch), jnp.int32)
+    return TraceWin(
+        ev_tick=z(r), ev_node=z(r), ev_kind=z(r), ev_detail=z(r), n=z()
+    )
+
+
+def init_persist(spec: TraceSpec, batch: int) -> TracePersist:
+    return TracePersist(
+        frozen=jnp.zeros((batch,), bool),
+        last_kind=jnp.zeros((batch,), jnp.int32),
+        cov=jnp.zeros((COV_WORDS, batch), jnp.uint32),
+        total=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def _coverage(spec, tp, write, ev, kv, prev_kind):
+    """OR this tick's (role x kind) and (prev-kind -> kind) bits into the
+    packed coverage words. `write` [M, B] gates; kv is the static [M] slot
+    kind table; prev_kind [M, B] the adjacency predecessor per slot."""
+    b = write.shape[1]
+    # role x kind block: one-hot the role axis, any-reduce each static kind
+    # block -> [ROLE_DIM, N_KINDS, B] occurrence matrix.
+    r_oh = (
+        jnp.arange(tev.ROLE_DIM, dtype=jnp.int32)[:, None, None] == ev.role[None]
+    ) & write[None]
+    rk = []
+    pk_oh = (
+        jnp.arange(tev.N_KINDS, dtype=jnp.int32)[:, None, None] == prev_kind[None]
+    ) & write[None]
+    adj = []
+    for k in range(tev.N_KINDS):
+        idx = np.flatnonzero(kv == k)
+        if idx.size == 0:
+            rk.append(jnp.zeros((tev.ROLE_DIM, b), bool))
+            adj.append(jnp.zeros((tev.N_KINDS, b), bool))
+        else:
+            rk.append(jnp.any(r_oh[:, idx], axis=1))
+            adj.append(jnp.any(pk_oh[:, idx], axis=1))
+    # [N_KINDS, ROLE_DIM, B] -> bit r * N_KINDS + k wants role-major flatten.
+    rk_m = jnp.stack(rk)  # [K, ROLE_DIM, B]
+    rk_flat = jnp.moveaxis(rk_m, 0, 1).reshape(ROLE_KIND_BITS, b)
+    adj_m = jnp.stack(adj)  # [K(next), K(prev), B] -> prev-major flatten
+    adj_flat = jnp.moveaxis(adj_m, 0, 1).reshape(tev.N_KINDS * tev.N_KINDS, b)
+    # pack pads the last word's tail bits to zero itself (canonical words).
+    bits = jnp.concatenate([rk_flat, adj_flat], axis=0)
+    return tp.cov | bitplane.pack(bits, axis=0)
+
+
+def record(
+    cfg: RaftConfig,
+    spec: TraceSpec,
+    tw: TraceWin,
+    tp: TracePersist,
+    ev: tev.TickEvents,
+    now: jax.Array,
+) -> tuple[TraceWin, TracePersist]:
+    """Fold one tick's extracted events into the window buffer + persist
+    legs. `now` is the [B] pre-tick absolute tick (lockstep). Compaction of
+    the sparse candidate slots into dense buffer positions is an exclusive
+    cumsum + one scatter per plane; events past `depth` are counted (n) but
+    not stored (module docstring: clamp, not wrap)."""
+    m = ev.flags.shape[0]
+    batch = ev.flags.shape[1]
+    kv = tev.slot_kinds(cfg.n_nodes)  # static [M]
+    nv = tev.slot_nodes(cfg.n_nodes)
+    write = ev.flags & ~tp.frozen[None, :]  # [M, B]
+    wi = write.astype(jnp.int32)
+    cum = jnp.cumsum(wi, axis=0)
+    emitted = cum[-1]  # [B]
+    pos = tw.n[None, :] + cum - wi  # exclusive cumsum offset
+    ok = write & (pos < spec.depth)
+    slot = jnp.where(ok, pos, spec.depth)  # out-of-range rows drop
+    biota = jnp.broadcast_to(jnp.arange(batch, dtype=jnp.int32)[None], (m, batch))
+    kv_b = jnp.broadcast_to(jnp.asarray(kv)[:, None], (m, batch))
+    nv_b = jnp.broadcast_to(jnp.asarray(nv)[:, None], (m, batch))
+    now_b = jnp.broadcast_to(now[None], (m, batch))
+    put = lambda plane, val: plane.at[slot, biota].set(val, mode="drop")
+    tw2 = TraceWin(
+        ev_tick=put(tw.ev_tick, now_b),
+        ev_node=put(tw.ev_node, nv_b),
+        ev_kind=put(tw.ev_kind, kv_b),
+        ev_detail=put(tw.ev_detail, ev.detail),
+        n=tw.n + emitted,
+    )
+    # Adjacency predecessor per slot: the kind of the latest valid slot
+    # strictly before it this tick, else the carried stream tail.
+    midx = jnp.where(write, jnp.arange(m, dtype=jnp.int32)[:, None], -1)
+    incl = lax.cummax(midx, axis=0)  # [M, B]
+    prev_idx = jnp.concatenate(
+        [jnp.full((1, batch), -1, jnp.int32), incl[:-1]], axis=0
+    )
+    kv_arr = jnp.asarray(kv)
+    prev_kind = jnp.where(
+        prev_idx >= 0,
+        kv_arr[jnp.clip(prev_idx, 0, m - 1)],
+        tp.last_kind[None, :],
+    )
+    cov = _coverage(spec, tp, write, ev, kv, prev_kind) if spec.coverage else tp.cov
+    last_idx = incl[-1]  # [B]
+    last_kind = jnp.where(
+        last_idx >= 0, kv_arr[jnp.clip(last_idx, 0, m - 1)], tp.last_kind
+    )
+    frozen = tp.frozen
+    if spec.freeze_kind:
+        hit_idx = np.flatnonzero(kv == spec.freeze_kind)
+        frozen = frozen | jnp.any(write[hit_idx], axis=0)
+    tp2 = TracePersist(
+        frozen=frozen, last_kind=last_kind, cov=cov, total=tp.total + emitted
+    )
+    return tw2, tp2
+
+
+def cov_popcount(cov) -> jax.Array:
+    """Set bits per cluster of a [COV_WORDS, B] coverage plane -> [B] int32
+    (or any leading layout: reduces the word axis 0)."""
+    return jnp.sum(lax.population_count(jnp.asarray(cov)).astype(jnp.int32), axis=0)

@@ -143,6 +143,19 @@ class RaftConfig:
     # a serve config with no offers ticks identically to the plain config.
     serve_ingest: bool = False
 
+    # Protocol trace plane (raft_sim_tpu/trace). When True, telemetry runs may
+    # carry the device-side event ring + transition-coverage bitmap
+    # (trace/ring.py) beside the window records: role transitions, term bumps,
+    # votes, commit advances, and fault-lattice events stream out per window
+    # for whole-history checking (trace/checker.py). Purely a structural gate
+    # with the same zero-cost-when-off contract as track_offer_ticks: with it
+    # False (the default) no trace leg exists in ANY compiled program -- every
+    # standing program lowers bit-identically to pre-trace builds -- and a
+    # telemetry run that requests tracing under a False gate is an error
+    # (sim/telemetry.py). Event EXTRACTION never perturbs the trajectory
+    # either way (tests/test_trace.py pins instrumented == plain).
+    track_trace: bool = False
+
     # PreVote (Raft thesis 9.6; BEYOND the reference, which has neither
     # pre-vote nor leadership transfer -- SURVEY.md 2.3.12). When True, an
     # expired node becomes a PRECANDIDATE and probes a majority at its

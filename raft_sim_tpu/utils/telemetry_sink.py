@@ -17,6 +17,24 @@ shared shape. This module is the one schema all of them write now:
                                tools/metrics_report.py or sim/trace.info_lines.
     <dir>/summary.json         the end-of-run FleetSummary rollup (plus caller
                                extras like wall time).
+    <dir>/trace_meta.json      OPTIONAL (driver --trace): the protocol trace
+                               stream's self-description -- event-kind name
+                               map, ring depth, coverage geometry -- so
+                               trace.jsonl decodes without importing this
+                               repo.
+    <dir>/trace.jsonl          OPTIONAL: one line per protocol event
+                               ({w, c, t, node, k, d}: window, cluster, tick,
+                               node id or -1 for cluster scope, kind code,
+                               detail), window-major then cluster then
+                               device slot order -- per-cluster ticks are
+                               non-decreasing, which validate() checks and
+                               the history loader (trace/history.py) treats
+                               as the stream-integrity invariant.
+    <dir>/trace_windows.jsonl  OPTIONAL: one line per trace window (emitted/
+                               retained/dropped event totals, sparse
+                               per-cluster drop map, cumulative coverage
+                               bits) -- the completeness ledger the checker
+                               reads before it is willing to PASS a history.
     <dir>/perf.jsonl           OPTIONAL: per-chunk runtime attribution rows
                                (obs/timer.py ChunkTimer) -- wall/dispatch/
                                host/device-wait seconds, warmup flag, device
@@ -147,13 +165,15 @@ class TelemetrySink:
             json.dump(manifest, f, indent=2, sort_keys=True)
             f.write("\n")
         open(self._path("windows.jsonl"), "w").close()  # truncate the stream
+        self._n_trace_windows = 0
         # A rebuilt run must not inherit the previous run's violation
-        # recordings, rollup, or perf stream: stale files under a fresh
-        # manifest would misattribute another run's data to this one.
-        # (perf.jsonl is only re-created if a ChunkTimer streams here.)
+        # recordings, rollup, or perf/trace streams: stale files under a
+        # fresh manifest would misattribute another run's data to this one.
+        # (perf.jsonl / trace*.jsonl are only re-created when armed.)
         for name in os.listdir(directory):
             if (name.startswith("flight_") and name.endswith(".jsonl")) or (
-                name in ("summary.json", "perf.jsonl")
+                name in ("summary.json", "perf.jsonl", "trace.jsonl",
+                         "trace_windows.jsonl", "trace_meta.json")
             ):
                 os.remove(os.path.join(directory, name))
 
@@ -212,6 +232,79 @@ class TelemetrySink:
             for row in rows:
                 f.write(json.dumps(row) + "\n")
         return len(rows)
+
+    def write_trace_meta(self, spec) -> str:
+        """Self-description of the trace stream (a trace.TraceSpec): written
+        once when tracing is armed so trace.jsonl decodes standalone."""
+        from raft_sim_tpu.trace import KINDS
+        from raft_sim_tpu.trace.ring import COV_BITS, COV_WORDS
+
+        path = self._path("trace_meta.json")
+        doc = {
+            "trace_schema": 1,
+            "kinds": dict(KINDS),
+            "depth": int(spec.depth),
+            "coverage": bool(spec.coverage),
+            "coverage_bits": COV_BITS,
+            "coverage_words": COV_WORDS,
+            "freeze_kind": int(spec.freeze_kind),
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return path
+
+    def append_trace(self, tracewins) -> int:
+        """Append one chunk's stacked trace windows (batch-minor
+        trace.TraceWindowOut, leaves [n_windows, ..., B]) as trace.jsonl event
+        lines + trace_windows.jsonl completeness rows. Returns the number of
+        windows appended. Event order on disk is window-major, then cluster,
+        then device slot order -- per-cluster tick monotone, the invariant
+        validate() and the history loader check."""
+        from raft_sim_tpu.trace.history import iter_window_events
+
+        n = np.asarray(tracewins.win.n)  # [W, B]
+        n_windows, batch = n.shape
+        depth = np.asarray(tracewins.win.ev_kind).shape[1]
+        kept = np.minimum(n, depth)
+        dropped = n - kept
+        # Cumulative coverage at each window's end ([W, C, B] uint32 words):
+        # report the fleet-max per-cluster popcount -- the "how much of the
+        # transition space has the best cluster seen" progress number.
+        from raft_sim_tpu.ops.bitplane import np_popcount_u32
+
+        cov = np.asarray(tracewins.cov)
+        cov_per = np.max(np_popcount_u32(cov).sum(axis=1), axis=-1)
+        per_window_events: dict[int, list] = {w: [] for w in range(n_windows)}
+        for w, c, evs in iter_window_events(tracewins):
+            per_window_events[w].append((c, evs))
+        with open(self._path("trace.jsonl"), "a") as f:
+            for w in range(n_windows):
+                widx = self._n_trace_windows + w
+                for c, evs in per_window_events[w]:
+                    for e in evs:
+                        f.write(json.dumps({
+                            "w": widx, "c": int(c), "t": e.tick,
+                            "node": e.node, "k": e.kind, "d": e.detail,
+                        }) + "\n")
+        with open(self._path("trace_windows.jsonl"), "a") as f:
+            for w in range(n_windows):
+                drop_map = {
+                    str(c): int(d)
+                    for c, d in enumerate(dropped[w])
+                    if d > 0
+                }
+                row = {
+                    "window": self._n_trace_windows + w,
+                    "emitted": int(n[w].sum()),
+                    "retained": int(kept[w].sum()),
+                    "dropped": int(dropped[w].sum()),
+                    "dropped_by_cluster": drop_map,
+                    "cov_bits_max": int(cov_per[w]),
+                }
+                f.write(json.dumps(row) + "\n")
+        self._n_trace_windows += n_windows
+        return n_windows
 
     def write_flight(self, cluster: int, ticks, infos: StepInfo) -> str:
         """Write one cluster's flight-recorder export (telemetry.export_cluster
@@ -361,6 +454,83 @@ def validate(directory: str) -> list[str]:
                             f"(expected {prev_chunk + 1})"
                         )
                     prev_chunk = row["chunk"]
+
+    trace_path = os.path.join(directory, "trace.jsonl")
+    if os.path.isfile(trace_path):
+        meta_path = os.path.join(directory, "trace_meta.json")
+        n_kinds = None
+        if not os.path.isfile(meta_path):
+            errors.append("trace.jsonl present but trace_meta.json missing")
+        else:
+            try:
+                with open(meta_path) as f:
+                    tmeta = json.load(f)
+                kinds = tmeta.get("kinds")
+                if not isinstance(kinds, dict) or not kinds:
+                    errors.append("trace_meta.json: missing kinds map")
+                else:
+                    n_kinds = max(kinds.values()) + 1
+            except (OSError, json.JSONDecodeError) as ex:
+                errors.append(f"trace_meta.json unreadable: {ex}")
+        last_tick: dict[int, int] = {}
+        with open(trace_path) as f:
+            for ln, raw in enumerate(f, 1):
+                try:
+                    row = json.loads(raw)
+                except json.JSONDecodeError as ex:
+                    errors.append(f"trace.jsonl:{ln}: not JSON: {ex}")
+                    continue
+                bad = [
+                    k for k in ("w", "c", "t", "node", "k", "d")
+                    if not isinstance(row.get(k), int) or row.get(k) is True
+                ]
+                if bad:
+                    errors.append(
+                        f"trace.jsonl:{ln}: fields {bad} missing or non-int"
+                    )
+                    continue
+                if n_kinds is not None and not 1 <= row["k"] < n_kinds:
+                    errors.append(
+                        f"trace.jsonl:{ln}: kind {row['k']} outside "
+                        f"[1, {n_kinds})"
+                    )
+                c = row["c"]
+                if row["t"] < last_tick.get(c, -1):
+                    errors.append(
+                        f"trace.jsonl:{ln}: cluster {c} tick {row['t']} "
+                        f"regresses (stream truncated or reordered)"
+                    )
+                last_tick[c] = max(last_tick.get(c, -1), row["t"])
+        tw_path = os.path.join(directory, "trace_windows.jsonl")
+        if not os.path.isfile(tw_path):
+            errors.append("trace.jsonl present but trace_windows.jsonl missing")
+        else:
+            prev_tw = -1
+            with open(tw_path) as f:
+                for ln, raw in enumerate(f, 1):
+                    try:
+                        row = json.loads(raw)
+                    except json.JSONDecodeError as ex:
+                        errors.append(f"trace_windows.jsonl:{ln}: not JSON: {ex}")
+                        continue
+                    for k in ("window", "emitted", "retained", "dropped"):
+                        if not isinstance(row.get(k), int) or row.get(k) is True:
+                            errors.append(
+                                f"trace_windows.jsonl:{ln}: field {k!r} "
+                                "missing or non-int"
+                            )
+                    if not isinstance(row.get("dropped_by_cluster"), dict):
+                        errors.append(
+                            f"trace_windows.jsonl:{ln}: dropped_by_cluster "
+                            "must be a map"
+                        )
+                    if isinstance(row.get("window"), int):
+                        if row["window"] != prev_tw + 1:
+                            errors.append(
+                                f"trace_windows.jsonl:{ln}: window index "
+                                f"{row['window']} (expected {prev_tw + 1})"
+                            )
+                        prev_tw = row["window"]
 
     for name in sorted(os.listdir(directory)):
         if not (name.startswith("flight_") and name.endswith(".jsonl")):

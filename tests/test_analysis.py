@@ -133,10 +133,10 @@ def test_recompile_fork_guard():
     # pre_vote genuinely forks the program: the guard must see it on BOTH the
     # plain scan and the scenario (genome-path) scan ...
     got = jaxpr_audit.check_recompile_forks((("config3", {"pre_vote": True}),))
-    assert [f.rule for f in got] == ["recompile-fork"] * 3
+    assert [f.rule for f in got] == ["recompile-fork"] * 4
     assert {f.path for f in got} == {
         "jaxpr:config3/simulate", "jaxpr:config3/scenario_simulate",
-        "jaxpr:config3/serve_simulate",
+        "jaxpr:config3/serve_simulate", "jaxpr:config3/trace_simulate",
     }
     # ... while a tuning-only change must not (one standing pair, cheap) --
     # and on the scenario program that includes the fault knobs themselves:

@@ -101,7 +101,7 @@ def test_golden_pins_every_audited_program():
         f"{c}/{p}"
         for c in JA.AUDIT_CONFIGS
         for p in ("step", "step_b", "simulate", "scenario_simulate",
-                  "serve_simulate")
+                  "serve_simulate", "trace_simulate")
     }
     assert set(_golden()["programs"]) == want
     for key, entry in _golden()["programs"].items():
@@ -404,11 +404,12 @@ def test_smoke_rows_never_attach_roofline_headroom():
     import bench as B
 
     for name in ("config1", "config3"):
-        prod = PRESETS[name][1]
-        assert B._pin_applies(name, prod, smoke=False)
-        assert not B._pin_applies(name, prod, smoke=True)
-    assert not B._pin_applies("config3", 64, smoke=False)  # custom batch
-    assert not B._pin_applies("custom", 64, smoke=False)   # no preset, no pin
+        cfg, prod = PRESETS[name]
+        assert B._pin_applies(name, cfg, prod, smoke=False)
+        assert not B._pin_applies(name, cfg, prod, smoke=True)
+    cfg3 = PRESETS["config3"][0]
+    assert not B._pin_applies("config3", cfg3, 64, smoke=False)  # custom batch
+    assert not B._pin_applies("custom", cfg3, 64, smoke=False)   # no preset, no pin
 
 
 def test_version_mismatch_is_a_visible_stale_pin_finding():

@@ -68,6 +68,13 @@ PINNED_SCENARIO_SCAN_LOWERINGS = 8
 # which is why this pin sits below the preset count. Command values are traced
 # data: a multi-chunk `driver serve` session compiles nothing after warmup.
 PINNED_SERVE_SCAN_LOWERINGS = 7
+# The protocol-trace program (telemetry windowed scan + event ring + coverage
+# legs, raft_sim_tpu/trace): at most one per preset -- these are "the pinned
+# trace variants" ISSUE 9's acceptance names: tracing adds ZERO step lowerings
+# (extraction is delta-based outside the kernels) and the coverage search's
+# generations all reuse one trace program (genomes are traced data; the
+# analyzer's trace fork pairs pin value-invariance).
+PINNED_TRACE_SCAN_LOWERINGS = 8
 
 
 def _pins():
@@ -81,6 +88,7 @@ def _pins():
         low.get("scan", PINNED_SCAN_LOWERINGS),
         low.get("scenario_scan", PINNED_SCENARIO_SCAN_LOWERINGS),
         low.get("serve_scan", PINNED_SERVE_SCAN_LOWERINGS),
+        low.get("trace_scan", PINNED_TRACE_SCAN_LOWERINGS),
     )
 
 
@@ -121,17 +129,21 @@ def test_golden_op_histograms():
 
 
 def test_compile_count_pin():
-    pin_step, pin_scan, pin_scenario, pin_serve = _pins()
+    pin_step, pin_scan, pin_scenario, pin_serve, pin_trace = _pins()
     step_hashes = set()
     scan_hashes = set()
     scenario_hashes = set()
     serve_hashes = set()
+    trace_hashes = set()
     for name, (cfg, _) in PRESETS.items():
         step_hashes.add(JA.program_hash(JA.step_jaxpr(cfg, batched=True)))
         scan_hashes.add(JA.program_hash(JA.scan_jaxpr(cfg)))
         scenario_hashes.add(JA.program_hash(JA.scenario_scan_jaxpr(cfg)))
         serve_hashes.add(
             JA.program_hash(JA.serve_scan_jaxpr(JA.serve_variant(cfg)))
+        )
+        trace_hashes.add(
+            JA.program_hash(JA.trace_scan_jaxpr(JA.trace_variant(cfg)))
         )
     assert len(step_hashes) <= pin_step, (
         f"{len(step_hashes)} distinct step_b lowerings across the preset "
@@ -162,6 +174,15 @@ def test_compile_count_pin():
         f"preset matrix (pinned {pin_serve}): a command- or chunk-content-"
         "dependent structure would recompile the standing fleet mid-session."
     )
+    # The trace program: at most one per preset, and ZERO extra step
+    # lowerings (the step_hashes pin above already covers that claim --
+    # trace-mode configs compile the same step kernels).
+    assert len(trace_hashes) <= pin_trace, (
+        f"{len(trace_hashes)} distinct trace_simulate lowerings across the "
+        f"preset matrix (pinned {pin_trace}): a trace-depth- or coverage-"
+        "dependent structural fork would recompile the coverage hunt per "
+        "sweep point (the scenario-engine failure mode, ISSUE 4/9)."
+    )
 
 
 def _update():
@@ -172,6 +193,7 @@ def _update():
             "scan": PINNED_SCAN_LOWERINGS,
             "scenario_scan": PINNED_SCENARIO_SCAN_LOWERINGS,
             "serve_scan": PINNED_SERVE_SCAN_LOWERINGS,
+            "trace_scan": PINNED_TRACE_SCAN_LOWERINGS,
         },
         "programs": _histograms(),
     }

@@ -192,6 +192,60 @@ def render_flight(directory: str, cluster: int, out=None) -> None:
         print(f"tick {t:>8}  {line[line.index('leader='):]}", file=out)
 
 
+def report_trace(directory: str, clusters=None, limit: int = 40,
+                 perfetto: str | None = None, out=None) -> None:
+    """Render a directory's protocol trace (trace.jsonl) as per-cluster
+    timelines, run the whole-history checker over it, and optionally export
+    Chrome-trace/Perfetto JSON (`perfetto` path): one process per cluster,
+    one track per node, instant events named by kind -- opens in
+    ui.perfetto.dev next to the --profile captures (PR 8)."""
+    from raft_sim_tpu.trace import checker as tchecker
+    from raft_sim_tpu.trace import history as thistory
+
+    hist = thistory.load(directory)
+    if not hist.events:
+        raise SystemExit(
+            f"{directory}: no trace.jsonl events (run with --trace to record)"
+        )
+    total = sum(len(v) for v in hist.events.values())
+    dropped = sum(hist.dropped.values())
+    print(
+        f"protocol trace: {directory}\n"
+        f"  {total} events, {len(hist.events)} clusters, "
+        f"{hist.n_windows} windows, {dropped} dropped"
+        + ("" if hist.complete else "  [INCOMPLETE]"),
+        file=out,
+    )
+    sel = sorted(hist.events) if clusters is None else list(clusters)
+    for c in sel:
+        evs = hist.events.get(c, [])
+        if not evs:
+            continue
+        print(f"\n  cluster {c}: {len(evs)} events"
+              + (f" ({hist.dropped.get(c, 0)} dropped)" if hist.dropped.get(c) else ""),
+              file=out)
+        lines = list(thistory.timeline_lines(hist, c))
+        shown = lines if limit is None or len(lines) <= limit else lines[:limit]
+        for line in shown:
+            print(f"    {line}", file=out)
+        if len(lines) > len(shown):
+            print(f"    ... {len(lines) - len(shown)} more "
+                  f"(--trace-limit 0 for all)", file=out)
+    rep = tchecker.check_history(hist)
+    print("\n  history checks:", file=out)
+    for name, r in rep.results.items():
+        verdict = {True: "ok", False: "VIOLATED", None: "undecided"}[r.ok]
+        print(f"    {name:<22} {verdict}" + (f"  ({r.note})" if r.note else ""),
+              file=out)
+    if perfetto:
+        doc = thistory.chrome_trace(hist, clusters=sel)
+        with open(perfetto, "w") as f:
+            json.dump(doc, f)
+        print(f"\n  perfetto trace written: {perfetto} "
+              f"({len(doc['traceEvents'])} events; open in ui.perfetto.dev)",
+              file=out)
+
+
 def report_perf_dir(directory: str, out=None) -> None:
     """Render a telemetry directory's perf.jsonl (obs.ChunkTimer rows): the
     per-chunk attribution table, the steady-state rollup, and the
@@ -356,7 +410,33 @@ def main(argv=None) -> int:
                          "the cost-model pins) or a MEASUREMENT_r*.json "
                          "artifact (measured-vs-predicted roofline table, "
                          "A/B deltas, BENCH trajectory)")
+    ap.add_argument("--trace", action="store_true",
+                    help="protocol-trace report: per-cluster event timelines "
+                         "from trace.jsonl plus the whole-history checker "
+                         "verdicts (raft_sim_tpu/trace)")
+    ap.add_argument("--trace-cluster", type=int, action="append", default=None,
+                    metavar="C", help="restrict --trace to cluster C (repeatable)")
+    ap.add_argument("--trace-limit", type=int, default=40,
+                    help="timeline lines shown per cluster (0 = all; default 40)")
+    ap.add_argument("--perfetto", metavar="OUT.json", default=None,
+                    help="with --trace: also export the timelines as "
+                         "Chrome-trace/Perfetto JSON (one track per node, "
+                         "events named by kind; open in ui.perfetto.dev)")
     args = ap.parse_args(argv)
+
+    if args.trace:
+        if len(args.paths) != 1:
+            ap.error("--trace needs exactly one telemetry directory")
+        errors = sink.validate(args.paths[0])
+        if errors:
+            for e in errors:
+                print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        report_trace(
+            args.paths[0], clusters=args.trace_cluster,
+            limit=args.trace_limit or None, perfetto=args.perfetto,
+        )
+        return 0
 
     if args.perf:
         if len(args.paths) != 1:
