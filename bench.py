@@ -57,6 +57,7 @@ MATRIX_TICKS = {
     "config1": 10_000,
     "config2": 2_000,
     "config3": 500,
+    "config3p": 500,
     "config4": 300,
     "config4c": 300,
     "config5": 200,
@@ -66,6 +67,7 @@ MATRIX_TICKS = {
 SMOKE_BATCH = {
     "config2": 64,
     "config3": 512,
+    "config3p": 512,
     "config4": 256,
     "config4c": 256,
     "config5": 16,
@@ -266,9 +268,11 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 3,
 # tools/metrics_report.py --perf refuses documents it does not recognize.
 MEASUREMENT_SCHEMA = "measurement-pass-v1"
 
+# config3p rides beside config3 so PreVote's cost is a standing measured
+# delta (same N/batch/ticks; the only difference is the pre_vote gate).
 MATRIX_CONFIGS = (
-    "config1", "config2", "config3", "config4", "config4c", "config5",
-    "config6", "config6r",
+    "config1", "config2", "config3", "config3p", "config4", "config4c",
+    "config5", "config6", "config6r",
 )
 
 
@@ -595,6 +599,10 @@ def main() -> None:
             "config1",
             "config2",
             "config3",
+            # The standing PreVote row: config3's exact sizing with pre_vote
+            # on, so the probe phases' cost is a measured delta every run
+            # (docs/PERF.md "PreVote cost"), not prose.
+            "config3p",
             "config4",
             "config4c",
             "config5",

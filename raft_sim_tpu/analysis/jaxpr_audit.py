@@ -106,6 +106,14 @@ FORK_PAIRS: tuple[tuple[str, dict], ...] = (
     ("config5", {"partition_prob": 0.4}),
     ("config6", {"crash_prob": 0.2, "drop_prob": 0.15}),
     ("config6r", {"client_interval": 8, "crash_down_ticks": 10}),
+    # Reconfiguration plane: the admin cadences are tuning knobs (values stay
+    # nonzero -- the structural gates are `> 0` checks by design, like
+    # client_interval), so retiming membership changes / transfers / reads
+    # must never fork a compile (the scenario genome retimes them as data).
+    ("config8", {
+        "reconfig_interval": 53, "transfer_interval": 31, "read_interval": 5,
+        "drop_prob": 0.15,
+    }),
 )
 
 
@@ -577,7 +585,12 @@ def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
 # plain (config3), wide + partitions + sampled log matching (config5),
 # client + log matching (config1), faults (config4), compaction + crash
 # (config6), redirect pipeline (config6r).
-AUDIT_CONFIGS = ("config1", "config3", "config4", "config5", "config6", "config6r")
+# config8 adds the reconfiguration-plane family (joint-consensus membership +
+# TimeoutNow + ReadIndex legs live).
+AUDIT_CONFIGS = (
+    "config1", "config3", "config4", "config5", "config6", "config6r",
+    "config8",
+)
 
 
 def run_pass(config_names=AUDIT_CONFIGS, fork_pairs=FORK_PAIRS) -> list[Finding]:

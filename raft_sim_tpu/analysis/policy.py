@@ -88,7 +88,10 @@ _COMMENT_RE = re.compile(
     rf"(?P<dtypes>(?:{_DTYPE_TOKEN})(?:/(?:{_DTYPE_TOKEN}))*)"
     rf"(?:\s*\((?P<policy>{'|'.join(POLICY_DTYPES)})\))?"
 )
-_FIELD_RE = re.compile(r"^\s*(\w+):\s*jax\.Array\s*#\s*(.*)$")
+# Optional `= <default>` between the annotation and the comment: StepInputs'
+# reconfiguration-plane fields default to the Python-int NIL sentinel so
+# hand-built test inputs stay valid (types.py).
+_FIELD_RE = re.compile(r"^\s*(\w+):\s*jax\.Array(?:\s*=\s*[\w.+-]+)?\s*#\s*(.*)$")
 
 
 class FieldSpec:
@@ -210,6 +213,22 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
         # Plane on but no redirect pipeline: stamps never ride client slots
         # (direct acceptance stamps at injection).
         inv |= {"client_tick"}
+    # Reconfiguration plane (raft_sim_tpu/reconfig): each extension's state
+    # legs are dead weight unless its structural gate is on -- the
+    # zero-cost-when-off contract the tentpole inherits from
+    # track_offer_ticks/pre_vote/compaction.
+    if not cfg.reconfig:
+        inv |= {"member_old", "member_new", "cfg_epoch", "cfg_pend"}
+    if not cfg.leader_transfer:
+        inv |= {"xfer_to", "mb.xfer_tgt"}
+    if not cfg.read_index:
+        # The read slot AND its RunMetrics accumulators: scan._add_gated
+        # skips the fold when the kernel emits host-constant zeros, so the
+        # metric legs are var-identity passthroughs too.
+        inv |= {
+            "read_idx", "read_tick", "read_acks",
+            "metric.reads_served", "metric.read_lat_sum", "metric.read_hist",
+        }
     return inv
 
 

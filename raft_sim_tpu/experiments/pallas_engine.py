@@ -40,6 +40,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -138,6 +139,16 @@ def _make_kernel(cfg, n_state, n_inp, state_def, inp_def, in_ndims, out_def, out
         s2, info = raft_batched.step_b(cfg, s, inp)
         out_leaves, _ = jax.tree.flatten((s2, info))
         for r, v, nd in zip(out_refs, out_leaves, out_ndims):
+            if isinstance(v, np.ndarray):
+                # Structurally-gated-off StepInfo metrics are HOST CONSTANTS
+                # (never jnp.zeros: an op would break the zero-cost-when-off
+                # step goldens -- models/raft_batched.py). pallas_call
+                # refuses closed-over array consts, so materialize them as
+                # an in-kernel op here. Guard the zero assumption: a future
+                # nonzero host-constant leaf (a NIL sentinel, say) must fail
+                # loudly, not silently diverge from step_b.
+                assert not np.any(v), "nonzero host-constant StepInfo leaf"
+                v = jnp.zeros(v.shape, v.dtype)
             r[...] = _lift(v) if nd == 1 else v
 
     return kernel

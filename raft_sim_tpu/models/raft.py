@@ -50,22 +50,28 @@ to [batch, ...] and `lax.scan` (sim/scan.py) rolls ticks.
 
 TRACE DELTA CONTRACT (raft_sim_tpu/trace, cfg.track_trace): the protocol
 trace plane derives discrete events from this kernel's state DELTAS --
-role, term, voted_for, commit_index, log_len -- outside the kernel (one
-extractor serves both kernels and any step_fn override; zero step
-lowerings added). Two properties of the phase order above are load-bearing
-for the whole-history checker and must survive refactors: (1) a node that
-loses leadership and accepts entries in one tick changes `role` in the SAME
-tick as `log_len` (phase 1 adoption precedes phase 3 append -- the checker
-replays role changes before log changes), and (2) a win (phase 4) can never
-co-occur with an AE-accept truncation on the same node (a candidate that
-accepted a current-term AE stepped down in phase 3 and cannot win). See
-trace/events.py.
+role, term, voted_for, commit_index, log_len, and (reconfiguration plane)
+cfg_epoch, xfer_to, read_idx -- outside the kernel (one extractor serves
+both kernels and any step_fn override; zero step lowerings added). Phase-
+order properties load-bearing for the whole-history checker, which must
+survive refactors: (1) a node that loses leadership and accepts entries in
+one tick changes `role` in the SAME tick as `log_len` (phase 1 adoption
+precedes phase 3 append -- the checker replays role changes before log
+changes); (2) a win (phase 4) can never co-occur with an AE-accept
+truncation on the same node (a candidate that accepted a current-term AE
+stepped down in phase 3 and cannot win); (3) elections precede the
+phase-5.2 configuration transition, so EV_LEADER events belong to the
+TICK-START epoch (EV_EPOCH replays at end-of-tick); (4) a read slot dropped
+while its holder stays a same-term un-restarted leader was SERVED -- every
+cancel path changes role/term or sets `restarted` (phase 5.2's clear
+rules). See trace/events.py.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_sim_tpu.ops import bitplane, log_ops
 from raft_sim_tpu.types import (
@@ -78,6 +84,7 @@ from raft_sim_tpu.types import (
     PRECANDIDATE,
     REQ_APPEND,
     REQ_PREVOTE,
+    REQ_TIMEOUT_NOW,
     REQ_VOTE,
     RESP_APPEND,
     RESP_PREVOTE,
@@ -95,6 +102,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
     track = cfg.track_offer_ticks  # static: offer-tick plane + latency metric active
+    rcf = cfg.reconfig  # static: joint-consensus membership plane active
+    xfr = cfg.leader_transfer  # static: TimeoutNow transfer plane active
+    rdx = cfg.read_index  # static: ReadIndex read traffic class active
     ids = jnp.arange(n, dtype=jnp.int32)
     eye = jnp.eye(n, dtype=bool)
     eye_p = bitplane.eye(n)  # [N, W] packed self-bit rows (votes plane layout)
@@ -129,8 +139,44 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
                 rs, s.clock - cfg.election_min_ticks, s.heard_clock
             )
         )
+    if xfr:
+        # A pending transfer is volatile leader state: lost with the process.
+        s = s._replace(xfer_to=jnp.where(rs, NIL, s.xfer_to))
+    if rdx:
+        # Pending reads die with the process too (the client retries).
+        s = s._replace(
+            read_idx=jnp.where(rs, 0, s.read_idx),
+            read_tick=jnp.where(rs, 0, s.read_tick),
+            read_acks=jnp.where(rs[:, None], zw, s.read_acks),
+        )
     mb = s.mailbox
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk
+
+    # Reconfiguration plane (cfg.reconfig): configuration-masked quorums.
+    # member_old/member_new are cluster-scoped packed rows (ClusterState
+    # docstring); during a joint phase (cfg_pend > 0) every quorum test needs
+    # a majority of BOTH configurations -- the thesis-4.3 rule whose absence
+    # (cfg.joint_consensus False, TEST-ONLY mutant) is the classic one-step
+    # membership-change bug. Quorum tests below read the TICK-START
+    # configuration; the admin transition phase (5.2) applies changes for the
+    # next tick's tests but demotes removed leaders immediately.
+    if rcf:
+        m_old, m_new = s.member_old, s.member_new  # [W]
+        joint = s.cfg_pend > 0  # scalar
+        maj_old = bitplane.count(m_old, axis=0) // 2 + 1  # scalar int32
+        maj_new = bitplane.count(m_new, axis=0) // 2 + 1
+        member_b = bitplane.unpack(m_old | m_new, n, axis=0)  # [N] bool
+
+        def packed_quorum(rows):
+            """[N, W] packed grant rows -> [N] bool config-masked quorum."""
+            ok = bitplane.count(rows & m_old[None, :], axis=1) >= maj_old
+            return ok & (
+                ~joint | (bitplane.count(rows & m_new[None, :], axis=1) >= maj_new)
+            )
+    else:
+
+        def packed_quorum(rows):
+            return bitplane.count(rows, axis=1) >= cfg.quorum
 
     # ---- phase 0: delivery -------------------------------------------------------
     # The fault mask is the TPU-native form of the reference's silently-dropped HTTP
@@ -403,6 +449,37 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     else:
         heard = s.heard_clock
 
+    # ---- phase 3.7: TimeoutNow receipt (thesis 3.10; cfg.leader_transfer) --------
+    # The transfer target starts a REAL election IMMEDIATELY: no timer, no
+    # pre-vote probe (the thesis's explicit bypass -- the target is known
+    # caught up, and the transferring leader's lease would make every voter
+    # deny a probe). Gated on the request carrying the receiver's CURRENT
+    # term, so a stale TimeoutNow from a deposed leader (or one that already
+    # succeeded: the new leader's term moved past it) is inert. The election
+    # itself fires in phase 7 alongside timer-driven starts.
+    if xfr:
+        is_tn = req_in & (mb.req_type == REQ_TIMEOUT_NOW)[:, None]  # [sender, recv]
+        tn_cur = (
+            is_tn
+            & (mb.xfer_tgt[:, None] == ids[None, :])
+            & (mb.req_term[:, None] == term[None, :])
+        )
+        xfer_elect = jnp.any(tn_cur, axis=0) & inp.alive & (role != LEADER)
+        if rcf:
+            xfer_elect = xfer_elect & member_b  # non-voters never campaign
+        if not cfg.xfer_election:
+            # TEST-ONLY mutant (cfg.xfer_election False): transfer as a coup.
+            # The target assumes leadership DIRECTLY -- no vote round, no
+            # up-to-date check -- so a behind target replicates its short log
+            # over committed entries (the violation the hunt must re-find).
+            coup = xfer_elect
+            term = term + coup
+            role = jnp.where(coup, LEADER, role)
+            leader_id = jnp.where(coup, ids, leader_id)
+            xfer_elect = jnp.zeros((n,), bool)
+        else:
+            coup = jnp.zeros((n,), bool)
+
     # ---- phase 4: responses ------------------------------------------------------
     # Vote tally (vote-response-handler core.clj:125-139; dedup via bitmap mirrors the
     # reference's set, core.clj:133-134). Granted = this responder's one grant
@@ -416,10 +493,18 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     )
     votes = votes | bitplane.pack(new_votes, axis=1)
     # Quorum test on the packed plane: word popcount instead of an [N, N]
-    # bool-plane sum (the bitplane module's reason to exist).
-    n_votes = bitplane.count(votes, axis=1)
+    # bool-plane sum (the bitplane module's reason to exist). With the
+    # reconfiguration plane live the popcount is configuration-masked (and
+    # DUAL during a joint phase) -- packed_quorum above.
     # A down candidate cannot assume leadership from votes banked before it crashed.
-    win = (role == CANDIDATE) & (n_votes >= cfg.quorum) & inp.alive
+    win = (role == CANDIDATE) & packed_quorum(votes) & inp.alive
+    if rcf:
+        # A node voted out of both configurations cannot assume leadership
+        # from votes banked before its removal.
+        win = win & member_b
+    if xfr and not cfg.xfer_election:
+        # Mutant coup targets take the fresh-leader bookkeeping path too.
+        win = win | coup
     role = jnp.where(win, LEADER, role)
     leader_id = jnp.where(win, ids, leader_id)
     # Fresh leader bookkeeping (leader-state core.clj:40-42): nextIndex = last log
@@ -445,8 +530,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
             zw,
         )
         votes = votes | new_pv
-        n_pv = bitplane.count(votes, axis=1)
-        pre_win = (role == PRECANDIDATE) & (n_pv >= cfg.quorum) & inp.alive
+        pre_win = (role == PRECANDIDATE) & packed_quorum(votes) & inp.alive
+        if rcf:
+            pre_win = pre_win & member_b
         term = term + pre_win
         role = jnp.where(pre_win, CANDIDATE, role)
         voted_for = jnp.where(pre_win, ids, voted_for)
@@ -486,8 +572,31 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # ---- phase 5: leader commit advancement (absent in reference, bug 2.3.8) ------
     is_leader = role == LEADER
     match_with_self = jnp.where(eye, log_len[:, None], match_index)  # [N, N]
-    sorted_desc = -jnp.sort(-match_with_self, axis=1)
-    quorum_match = sorted_desc[:, cfg.quorum - 1]  # quorum-th largest match index
+    if rcf:
+        # Configuration-masked quorum match: the largest replicated index v
+        # such that a majority of the config's members have match >= v. The
+        # quorum-th order statistic of a multiset is an element of it, so
+        # candidates range over the members' own match values (count form --
+        # the member majority is traced data, so the static sort-and-index
+        # form cannot apply). During joint: the min over both configs (an
+        # index is committed only when replicated to majorities of BOTH).
+        mws = match_with_self
+        ge = mws[:, None, :] >= mws[:, :, None]  # [i, j(candidate), k(counted)]
+
+        def masked_qmatch(mask_b, maj):
+            cnt = jnp.sum(ge & mask_b[None, None, :], axis=2)  # [N, N]
+            ok = (cnt >= maj) & mask_b[None, :]
+            return jnp.max(jnp.where(ok, mws, 0), axis=1).astype(jnp.int32)
+
+        mem_old_b = bitplane.unpack(m_old, n, axis=0)  # [N] bool
+        mem_new_b = bitplane.unpack(m_new, n, axis=0)
+        qm_old = masked_qmatch(mem_old_b, maj_old)
+        quorum_match = jnp.where(
+            joint, jnp.minimum(qm_old, masked_qmatch(mem_new_b, maj_new)), qm_old
+        )
+    else:
+        sorted_desc = -jnp.sort(-match_with_self, axis=1)
+        quorum_match = sorted_desc[:, cfg.quorum - 1]  # quorum-th largest match index
     # Spec 5.4.2: only commit entries from the current term by counting replicas.
     if comp:
         quorum_term = log_ops.term_at_r(log_term_arr, base, bterm, quorum_match)
@@ -498,6 +607,150 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         quorum_match,
         commit,
     )
+
+    # ---- phase 5.2: reconfiguration admin ----------------------------------------
+    # Membership transitions (cfg.reconfig): joint exit, then command accept,
+    # then removed-leader stepdown. Quorum tests this tick already ran on the
+    # tick-start configuration; transitions below govern the NEXT tick's
+    # quorums -- except stepdown, which is immediate (a leader voted out of
+    # both configurations must not finish the tick with authority: it would
+    # heartbeat, inject, and commit from outside the voting set).
+    if rcf:
+        # Exit the joint phase once a live member leader's commit covers the
+        # change point: everything through cfg_pend - 1 is replicated under
+        # the DUAL quorum, so the new configuration's majority holds the
+        # whole committed prefix and C_new can take over alone (thesis 4.3's
+        # C_old,new-committed condition in this model's admin terms).
+        exit_j = joint & jnp.any(
+            is_leader & inp.alive & member_b & (commit >= s.cfg_pend - 1)
+        )
+        m_old2 = jnp.where(exit_j, m_new, m_old)
+        cfg_pend = jnp.where(exit_j, 0, s.cfg_pend)
+        cfg_epoch = s.cfg_epoch + exit_j
+        joint2 = cfg_pend > 0
+        # Accept a membership toggle: owned by the lowest-id live member
+        # leader (the admin's POST target), refused while a joint phase is
+        # pending, and refused when the toggle would leave < 2 voters.
+        memb_mid = bitplane.unpack(m_old2 | m_new, n, axis=0)
+        ld_ok = is_leader & inp.alive & memb_mid
+        ld = jnp.min(jnp.where(ld_ok, ids, n))
+        t_r = inp.reconfig_cmd
+        tbit = bitplane.one_bit(t_r, n)  # [W]; all-zero row for NIL
+        toggled = m_new ^ tbit
+        accept = (
+            (t_r != NIL)
+            & ~joint2
+            & (ld < n)
+            & (bitplane.count(tbit, axis=0) > 0)
+            & (bitplane.count(toggled, axis=0) >= 2)
+        )
+        ld_len = log_len[jnp.minimum(ld, n - 1)]
+        if cfg.joint_consensus:
+            # Enter the joint phase: C_new diverges, quorums go dual next
+            # tick, and the exit bound is the owning leader's current log
+            # frontier + 1 (exit once commit reaches it).
+            m_new2 = jnp.where(accept, toggled, m_new)
+            m_old3 = m_old2
+            cfg_pend = jnp.where(accept, ld_len + 1, cfg_pend)
+        else:
+            # TEST-ONLY mutant (cfg.joint_consensus False): the one-step
+            # membership change -- both configurations switch instantly, no
+            # joint phase, so consecutive changes can produce old/new
+            # majorities that do not intersect (the thesis-4.3 bug the CE
+            # hunt must re-find).
+            m_new2 = jnp.where(accept, toggled, m_new)
+            m_old3 = jnp.where(accept, toggled, m_old2)
+        cfg_epoch = cfg_epoch + accept
+        # Removed-leader stepdown ("non-voting catch-up": the node stays
+        # simulated -- it keeps receiving entries as a learner -- but holds
+        # no role and, via the phase-7 membership gate, never campaigns).
+        member_b2 = bitplane.unpack(m_old3 | m_new2, n, axis=0)
+        demote = ~member_b2 & (role != FOLLOWER)
+        role = jnp.where(demote, FOLLOWER, role)
+        leader_id = jnp.where(demote, NIL, leader_id)
+        is_leader = role == LEADER
+    # Leadership-transfer bookkeeping (cfg.leader_transfer): abort a pending
+    # transfer whose holder lost leadership or whose target went unresponsive
+    # (ack_age horizon -- a dead target must not freeze the write path), then
+    # accept a fresh transfer command at the lowest-id live leader. The
+    # TimeoutNow itself fires from phase 8, re-fired each heartbeat while the
+    # target stays caught up (a dropped fire retries).
+    if xfr:
+        tcl = jnp.clip(s.xfer_to, 0, n - 1)
+        age_t = jnp.take_along_axis(ack_age, tcl[:, None], axis=1)[:, 0]
+        keep_x = is_leader & (s.xfer_to != NIL) & (age_t <= cfg.ack_timeout_ticks)
+        xfer_to = jnp.where(keep_x, s.xfer_to, NIL)
+        t_x = inp.transfer_cmd
+        ld_ok_x = is_leader & inp.alive
+        if rcf:
+            ld_ok_x = ld_ok_x & member_b2
+            # The target must be a voter of the target configuration.
+            t_voter = jnp.any((m_new2 & bitplane.one_bit(t_x, n)) != 0)
+        else:
+            t_voter = jnp.bool_(True)
+        ldx = jnp.min(jnp.where(ld_ok_x, ids, n))
+        can_x = (
+            (t_x != NIL) & t_voter & (ids == ldx) & ld_ok_x
+            & (t_x != ids) & (xfer_to == NIL)
+        )
+        xfer_to = jnp.where(can_x, t_x, xfer_to)
+        xfer_pend = xfer_to != NIL
+    # ReadIndex lifecycle (cfg.read_index): bank this tick's AppendEntries
+    # responses into the pending read's confirmation set (responses received
+    # now were sent at or after the capture tick, so each proves the
+    # responder was in the leader's term no earlier than capture -- the
+    # staleness argument docs/PROTOCOL.md spells out), serve once a
+    # configuration-aware majority confirms, then capture a fresh offer into
+    # a free slot.
+    if rdx:
+        pend0 = s.read_idx > 0  # pending at tick start
+        keep_r = is_leader & pend0  # role loss / term adoption cancels
+        read_acks = jnp.where(
+            keep_r[:, None], s.read_acks | bitplane.pack(aresp, axis=1), zw
+        )
+        if cfg.read_confirm:
+            serve = keep_r & inp.alive & packed_quorum(read_acks | eye_p)
+        else:
+            # TEST-ONLY mutant (cfg.read_confirm False): serve with NO
+            # leadership confirmation -- a deposed leader in a minority
+            # partition serves reads from its stale commit state (the
+            # below-the-committed-frontier read the checker must reject).
+            serve = keep_r & inp.alive
+        lat_r = jnp.maximum(s.now + 1 - s.read_tick, 1)  # [N]
+        reads_served = jnp.sum(serve).astype(jnp.int32)
+        read_lat_sum = jnp.sum(jnp.where(serve, lat_r, 0)).astype(jnp.int32)
+        bin_r = log_ops.log2_bin(lat_r, LAT_HIST_BINS)
+        oh_r = (
+            jnp.arange(LAT_HIST_BINS)[None, :] == bin_r[:, None]
+        ) & serve[:, None]
+        read_hist = jnp.sum(oh_r, axis=0).astype(jnp.int32)
+        # Capture: gated on the leader having committed a current-term entry
+        # (thesis 6.4 -- a fresh leader's commit may trail the global
+        # committed frontier until its own no-op/first entry commits, and a
+        # read captured before that would legally miss committed writes).
+        # One offer per cluster per tick: the lowest-id eligible leader.
+        if comp:
+            cur_committed = log_ops.term_at_r(log_term_arr, base, bterm, commit) == term
+        else:
+            cur_committed = log_ops.term_at(log_term_arr, commit) == term
+        can_cap = (inp.read_cmd != NIL) & is_leader & inp.alive & ~pend0
+        if cfg.read_confirm:
+            can_cap = can_cap & cur_committed
+        if xfr:
+            can_cap = can_cap & ~xfer_pend  # transferring leaders stop serving
+        low_cap = jnp.min(jnp.where(can_cap, ids, n))
+        cap_r = can_cap & (ids == low_cap)
+        cleared = serve | (pend0 & ~keep_r)
+        read_idx = jnp.where(cap_r, commit + 1, jnp.where(cleared, 0, s.read_idx))
+        read_tick = jnp.where(cap_r, s.now + 1, jnp.where(cleared, 0, s.read_tick))
+        read_acks = jnp.where((cap_r | serve)[:, None], zw, read_acks)
+    else:
+        # Constants, not jnp.zeros: a zeros op would land in the lowered
+        # step program and break the zero-cost-when-off golden (byte-
+        # identical op histograms with every gate off).
+        reads_served = np.int32(0)
+        read_lat_sum = np.int32(0)
+        read_hist = np.zeros((LAT_HIST_BINS,), np.int32)
 
     # ---- offer->commit latency (client workloads only) ---------------------------
     # Each client entry's offer stamp rides the log_tick plane (phase 6 writes
@@ -539,15 +792,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         lat_excluded = jnp.maximum(
             jnp.sum(crossed).astype(jnp.int32) - lat_cnt, 0
         )
-        # Histogram bin = floor(log2(l)), clamped to the last bin: bit length
-        # via an unrolled binary reduction (no float log in the hot loop).
-        bl = jnp.zeros_like(lats)
-        v = lats
-        for sft in (16, 8, 4, 2, 1):
-            m_ = v >= (1 << sft)
-            bl = bl + m_ * sft
-            v = jnp.where(m_, v >> sft, v)
-        bin_ = jnp.minimum(bl, LAT_HIST_BINS - 1)
+        # Histogram bin = floor(log2(l)), clamped to the last bin
+        # (log_ops.log2_bin: the one binning copy, shared with the
+        # read-latency histogram and both kernels).
+        bin_ = log_ops.log2_bin(lats, LAT_HIST_BINS)
         oh_b = (jnp.arange(LAT_HIST_BINS)[None, None, :] == bin_[:, :, None]) & lm[:, :, None]
         lat_hist = jnp.sum(oh_b, axis=(0, 1)).astype(jnp.int32)  # [BINS]
         lat_frontier = jnp.maximum(s.lat_frontier, jnp.max(commit))
@@ -648,6 +896,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         tgt_oh = active[:, None] & (tgt[:, None] == ids[None, :])  # [K, N]
         low_k = jnp.min(jnp.where(tgt_oh, kk[:, None], kdim), axis=0)  # [N]
         node_ok = is_leader & inp.alive & room & ~noop
+        if xfr:
+            # Transfer lease handoff (thesis 3.10): a transferring leader
+            # stops accepting client commands until the transfer completes
+            # or aborts.
+            node_ok = node_ok & ~xfer_pend
         client_ok = (low_k < kdim) & node_ok  # [N] nodes accepting a slot
         sel_k = tgt_oh & (kk[:, None] == low_k[None, :]) & node_ok[None, :]  # [K, N]
         wval_cl = jnp.sum(jnp.where(sel_k, pend[:, None], 0), axis=0)  # [N]
@@ -671,6 +924,8 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         client_tick = jnp.where(pend_on, ptick, 0) if track else s.client_tick
     else:
         client_ok = (inp.client_cmd != NIL) & is_leader & inp.alive & room & ~noop
+        if xfr:
+            client_ok = client_ok & ~xfer_pend  # transfer lease handoff
         wval_cl = jnp.broadcast_to(inp.client_cmd, (n,))
         # Direct mode accepts on the offer tick itself: stamp = now + 1 (the
         # same stamp the redirect pipeline records at slot entry).
@@ -721,14 +976,41 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         # untouched (grants stay possible), the self pre-vote rides the bitmap.
         # The REAL election start is this tick's promotions (phase 4.5).
         start_prevote = expired & ~is_leader
+        if rcf:
+            # Non-voters never campaign (the removed-node quiescence rule:
+            # a node outside both configurations is a learner).
+            start_prevote = start_prevote & member_b2
+        if xfr:
+            # A TimeoutNow target skips the probe: its real election (below)
+            # is the thesis-3.10 pre-vote bypass.
+            start_prevote = start_prevote & ~xfer_elect
         role = jnp.where(start_prevote, PRECANDIDATE, role)
         leader_id = jnp.where(start_prevote, NIL, leader_id)
         votes = jnp.where(start_prevote[:, None], eye_p, votes)
         deadline = jnp.where(start_prevote, clock + inp.timeout_draw, deadline)
         start_election = pre_win
+        if xfr:
+            # TimeoutNow election: real term bump + self-vote + RequestVote
+            # broadcast, exactly the promotion path minus the pre-quorum.
+            # ~is_leader re-checked: the target may have WON an ordinary
+            # election in phase 4 this very tick.
+            xe = xfer_elect & ~pre_win & ~is_leader
+            term = term + xe
+            role = jnp.where(xe, CANDIDATE, role)
+            voted_for = jnp.where(xe, ids, voted_for)
+            leader_id = jnp.where(xe, NIL, leader_id)
+            votes = jnp.where(xe[:, None], eye_p, votes)
+            deadline = jnp.where(xe, clock + inp.timeout_draw, deadline)
+            start_election = pre_win | xe
     else:
         start_prevote = jnp.zeros((n,), bool)
         start_election = expired & ~is_leader
+        if rcf:
+            start_election = start_election & member_b2  # non-voters never campaign
+        if xfr:
+            # TimeoutNow election (~is_leader re-checked: the target may have
+            # won an ordinary election in phase 4 this very tick).
+            start_election = start_election | (xfer_elect & ~is_leader)
         term = term + start_election
         role = jnp.where(start_election, CANDIDATE, role)
         voted_for = jnp.where(start_election, ids, voted_for)
@@ -761,6 +1043,29 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         # The probe carries the PROSPECTIVE term (term + 1, thesis 9.6); phase 1
         # excludes it from adoption.
         out_req_term = jnp.where(start_prevote, term + 1, out_req_term)
+    if xfr:
+        # TimeoutNow fire (thesis 3.10): on a heartbeat tick with a pending
+        # transfer whose target has fully matched the leader's log, the
+        # broadcast slot carries REQ_TIMEOUT_NOW instead of the heartbeat
+        # (re-fired each heartbeat while pending: a dropped fire retries; a
+        # successful one deposes this leader before the next). The AE window
+        # fields stay populated as the heartbeat would have left them --
+        # receivers gate every AE read on req_type == REQ_APPEND.
+        tcl8 = jnp.clip(xfer_to, 0, n - 1)
+        t_match = jnp.take_along_axis(match_index, tcl8[:, None], axis=1)[
+            :, 0
+        ].astype(jnp.int32)
+        if cfg.xfer_election:
+            caught = t_match >= log_len
+        else:
+            # TEST-ONLY mutant: fire without the catch-up wait (the coup
+            # receipt on the other side doesn't check the log either).
+            caught = jnp.ones((n,), bool)
+        fire = send_append & (xfer_to != NIL) & caught
+        out_req_type = jnp.where(fire, REQ_TIMEOUT_NOW, out_req_type)
+        out_xfer_tgt = jnp.where(fire, xfer_to, NIL).astype(jnp.int8)
+    else:
+        out_xfer_tgt = mb.xfer_tgt  # NIL, loop-invariant carry component
     # AE: prev = nextIndex - 1 per edge, carried as the offset into the shared window.
     prev_out = jnp.clip(next_index - 1, 0, log_len[:, None])  # [src, dst]
     # Shared window start: minimum prev over RESPONSIVE peers (acked an AE within
@@ -854,6 +1159,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         req_base_chk=(
             jnp.where(send_append, bchk, jnp.uint32(0)) if comp else mb.req_base_chk
         ),
+        xfer_tgt=out_xfer_tgt,
         req_off=out_req_off,
         resp_kind=out_resp_kind,
         pv_grant=out_pv_grant,
@@ -885,6 +1191,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         clock=clock,
         deadline=deadline,
         heard_clock=heard,
+        member_old=m_old3 if rcf else s.member_old,
+        member_new=m_new2 if rcf else s.member_new,
+        cfg_epoch=cfg_epoch if rcf else s.cfg_epoch,
+        cfg_pend=cfg_pend if rcf else s.cfg_pend,
+        xfer_to=xfer_to if xfr else s.xfer_to,
+        read_idx=read_idx if rdx else s.read_idx,
+        read_tick=read_tick if rdx else s.read_tick,
+        read_acks=read_acks if rdx else s.read_acks,
         client_pend=client_pend,
         client_dst=client_dst,
         client_tick=client_tick,
@@ -896,6 +1210,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     info = _step_info(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
+        reads_served, read_lat_sum, read_hist,
     )
     return new_state, info
 
@@ -914,6 +1229,9 @@ def _step_info(
     lat_hist: jax.Array,
     lat_excluded: jax.Array,
     noop_blocked: jax.Array,
+    reads_served: jax.Array,
+    read_lat_sum: jax.Array,
+    read_hist: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
     n = cfg.n_nodes
@@ -1040,4 +1358,7 @@ def _step_info(
         lat_excluded=lat_excluded,
         noop_blocked=noop_blocked,
         lm_skipped_pairs=lm_skipped,
+        reads_served=reads_served,
+        read_lat_sum=read_lat_sum,
+        read_hist=read_hist,
     )

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_sim_tpu.ops import bitplane, log_ops
 from raft_sim_tpu.types import (
@@ -30,6 +31,7 @@ from raft_sim_tpu.types import (
     PRECANDIDATE,
     REQ_APPEND,
     REQ_PREVOTE,
+    REQ_TIMEOUT_NOW,
     REQ_VOTE,
     RESP_APPEND,
     RESP_PREVOTE,
@@ -65,6 +67,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
     track = cfg.track_offer_ticks  # static: offer-tick plane + latency metric active
+    rcf = cfg.reconfig  # static: joint-consensus membership plane active
+    xfr = cfg.leader_transfer  # static: TimeoutNow transfer plane active
+    rdx = cfg.read_index  # static: ReadIndex read traffic class active
     b = s.role.shape[-1]
     # All iota-style constants are built at their final rank (log_ops.iota): Mosaic
     # cannot lower unit-dim-appending reshapes, and this module doubles as the
@@ -98,8 +103,40 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
                 rs, s.clock - cfg.election_min_ticks, s.heard_clock
             )
         )
+    if xfr:
+        # A pending transfer is volatile leader state (raft.py phase -1).
+        s = s._replace(xfer_to=jnp.where(rs, NIL, s.xfer_to))
+    if rdx:
+        # Pending reads die with the process too (raft.py phase -1).
+        s = s._replace(
+            read_idx=jnp.where(rs, 0, s.read_idx),
+            read_tick=jnp.where(rs, 0, s.read_tick),
+            read_acks=jnp.where(rs2, zw, s.read_acks),
+        )
     mb = s.mailbox
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk  # [N, B]
+
+    # Reconfiguration plane: configuration-masked quorums (raft.py). Masks
+    # are cluster-scoped [W, B] rows; tests read the TICK-START configuration
+    # (phase 5.2 applies transitions for the next tick, demotions aside).
+    if rcf:
+        m_old, m_new = s.member_old, s.member_new  # [W, B]
+        joint = s.cfg_pend > 0  # [B]
+        maj_old = bitplane.count(m_old, axis=0) // 2 + 1  # [B]
+        maj_new = bitplane.count(m_new, axis=0) // 2 + 1
+        member_b = bitplane.unpack(m_old | m_new, n, axis=0)  # [N, B]
+
+        def packed_quorum(rows):
+            """[N, W, B] packed grant rows -> [N, B] config-masked quorum."""
+            ok = bitplane.count(rows & m_old[None], axis=1) >= maj_old[None, :]
+            return ok & (
+                ~joint[None, :]
+                | (bitplane.count(rows & m_new[None], axis=1) >= maj_new[None, :])
+            )
+    else:
+
+        def packed_quorum(rows):
+            return bitplane.count(rows, axis=1) >= cfg.quorum
 
     # ---- phase 0: delivery -------------------------------------------------------
     # Input mask is per physical edge [to, from]; requests ([sender, receiver]) read
@@ -339,6 +376,28 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     else:
         heard = s.heard_clock
 
+    # ---- phase 3.7: TimeoutNow receipt (thesis 3.10; raft.py) --------------------
+    if xfr:
+        rcv_ids = iota((1, n, 1), 1)  # [1, N(receiver), 1]
+        is_tn = req_in & (mb.req_type == REQ_TIMEOUT_NOW)[:, None, :]
+        tn_cur = (
+            is_tn
+            & (mb.xfer_tgt[:, None, :] == rcv_ids)
+            & (mb.req_term[:, None, :] == term[None, :, :])
+        )
+        xfer_elect = jnp.any(tn_cur, axis=0) & inp.alive & (role != LEADER)
+        if rcf:
+            xfer_elect = xfer_elect & member_b  # non-voters never campaign
+        if not cfg.xfer_election:
+            # TEST-ONLY mutant: transfer as a coup (raft.py phase 3.7).
+            coup = xfer_elect
+            term = term + coup
+            role = jnp.where(coup, LEADER, role)
+            leader_id = jnp.where(coup, ids2, leader_id)
+            xfer_elect = jnp.zeros_like(coup)
+        else:
+            coup = jnp.zeros_like(xfer_elect)
+
     # ---- phase 4: responses ------------------------------------------------------
     vresp = resp_in & (mb.resp_kind == RESP_VOTE)
     new_votes = (
@@ -349,9 +408,13 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     )
     votes = votes | bitplane.pack(new_votes, axis=1)
     # Packed-quorum test: word popcount over [N, W, B] instead of a bool-plane
-    # sum over [N, N, B] (raft.py phase 4).
-    n_votes = bitplane.count(votes, axis=1)  # [N, B]
-    win = (role == CANDIDATE) & (n_votes >= cfg.quorum) & inp.alive
+    # sum over [N, N, B] (raft.py phase 4); configuration-masked (dual during
+    # joint phases) when the reconfiguration plane is live.
+    win = (role == CANDIDATE) & packed_quorum(votes) & inp.alive
+    if rcf:
+        win = win & member_b  # a removed node cannot win on banked votes
+    if xfr and not cfg.xfer_election:
+        win = win | coup  # mutant coups ride the fresh-leader bookkeeping
     role = jnp.where(win, LEADER, role)
     leader_id = jnp.where(win, ids2, leader_id)
     # Log indices are capacity-bounded (config caps log_capacity): the [N, N, B]
@@ -372,8 +435,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             zw,
         )
         votes = votes | new_pv
-        n_pv = bitplane.count(votes, axis=1)
-        pre_win = (role == PRECANDIDATE) & (n_pv >= cfg.quorum) & inp.alive
+        pre_win = (role == PRECANDIDATE) & packed_quorum(votes) & inp.alive
+        if rcf:
+            pre_win = pre_win & member_b
         term = term + pre_win
         role = jnp.where(pre_win, CANDIDATE, role)
         voted_for = jnp.where(pre_win, ids2, voted_for)
@@ -418,7 +482,28 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     #     count(match >= v) >= quorum. O(N^2) compares per leader, independent of CAP
     #     (the CAP-threshold form would do ~6x the work at N=5, CAP=32 and ~400x at
     #     config1's CAP=2048).
-    if cap < n and not comp:
+    if rcf:
+        # Configuration-masked quorum match (raft.py phase 5): candidates
+        # range over the members' own match values; the member majority is
+        # traced data, so only the count form applies. Joint: min over both
+        # configurations.
+        mws = match_with_self
+        ge_m = mws[:, None, :, :] >= mws[:, :, None, :]  # [i, j(cand), k, B]
+
+        def masked_qmatch(mask_b, maj):
+            cnt = jnp.sum(ge_m & mask_b[None, None, :, :], axis=2)  # [N, N, B]
+            ok = (cnt >= maj[None, None, :]) & mask_b[None, :, :]
+            return jnp.max(jnp.where(ok, mws, 0), axis=1).astype(jnp.int32)
+
+        mem_old_b = bitplane.unpack(m_old, n, axis=0)  # [N, B]
+        mem_new_b = bitplane.unpack(m_new, n, axis=0)
+        qm_old = masked_qmatch(mem_old_b, maj_old)
+        quorum_match = jnp.where(
+            joint[None, :],
+            jnp.minimum(qm_old, masked_qmatch(mem_new_b, maj_new)),
+            qm_old,
+        )
+    elif cap < n and not comp:
         # Thresholds 1..CAP only bound match values when indices are capacity-
         # bounded; compaction's absolute indices use the value-threshold form.
         vth = (iota((1, 1, cap, 1), 2) + 1).astype(match_with_self.dtype)  # 1..CAP
@@ -439,6 +524,114 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         quorum_match,
         commit,
     )
+
+    # ---- phase 5.2: reconfiguration admin (raft.py for the full rationale) -------
+    if rcf:
+        exit_j = joint & jnp.any(
+            is_leader & inp.alive & member_b
+            & (commit >= (s.cfg_pend - 1)[None, :]),
+            axis=0,
+        )  # [B]
+        m_old2 = jnp.where(exit_j[None, :], m_new, m_old)
+        cfg_pend = jnp.where(exit_j, 0, s.cfg_pend)
+        cfg_epoch = s.cfg_epoch + exit_j
+        joint2 = cfg_pend > 0
+        memb_mid = bitplane.unpack(m_old2 | m_new, n, axis=0)
+        ld_ok = is_leader & inp.alive & memb_mid
+        ld = jnp.min(jnp.where(ld_ok, ids2, n), axis=0)  # [B]
+        t_r = inp.reconfig_cmd  # [B]
+        tbit = bitplane.one_bit(t_r, n)  # [W, B]
+        toggled = m_new ^ tbit
+        accept = (
+            (t_r != NIL)
+            & ~joint2
+            & (ld < n)
+            & (bitplane.count(tbit, axis=0) > 0)
+            & (bitplane.count(toggled, axis=0) >= 2)
+        )
+        ld_len = jnp.sum(jnp.where(ids2 == ld[None, :], log_len, 0), axis=0)  # [B]
+        if cfg.joint_consensus:
+            m_new2 = jnp.where(accept[None, :], toggled, m_new)
+            m_old3 = m_old2
+            cfg_pend = jnp.where(accept, ld_len + 1, cfg_pend)
+        else:
+            # TEST-ONLY mutant: one-step membership change (raft.py).
+            m_new2 = jnp.where(accept[None, :], toggled, m_new)
+            m_old3 = jnp.where(accept[None, :], toggled, m_old2)
+        cfg_epoch = cfg_epoch + accept
+        member_b2 = bitplane.unpack(m_old3 | m_new2, n, axis=0)
+        demote = ~member_b2 & (role != FOLLOWER)
+        role = jnp.where(demote, FOLLOWER, role)
+        leader_id = jnp.where(demote, NIL, leader_id)
+        is_leader = role == LEADER
+    if xfr:
+        tgt_oh_x = iota((1, n, 1), 1) == jnp.clip(s.xfer_to, 0, n - 1)[:, None, :]
+        age_t = jnp.sum(jnp.where(tgt_oh_x, ack_age, 0), axis=1)  # one-hot gather
+        keep_x = is_leader & (s.xfer_to != NIL) & (age_t <= cfg.ack_timeout_ticks)
+        xfer_to = jnp.where(keep_x, s.xfer_to, NIL)
+        t_x = inp.transfer_cmd  # [B]
+        ld_ok_x = is_leader & inp.alive
+        if rcf:
+            ld_ok_x = ld_ok_x & member_b2
+            t_voter = jnp.any(
+                (m_new2 & bitplane.one_bit(t_x, n)) != 0, axis=0
+            )  # [B]
+        else:
+            t_voter = jnp.ones_like(t_x, bool)
+        ldx = jnp.min(jnp.where(ld_ok_x, ids2, n), axis=0)  # [B]
+        can_x = (
+            (t_x != NIL)[None, :]
+            & t_voter[None, :]
+            & (ids2 == ldx[None, :])
+            & ld_ok_x
+            & (t_x[None, :] != ids2)
+            & (xfer_to == NIL)
+        )
+        xfer_to = jnp.where(can_x, t_x[None, :], xfer_to)
+        xfer_pend = xfer_to != NIL
+    if rdx:
+        pend0 = s.read_idx > 0  # [N, B]
+        keep_r = is_leader & pend0
+        read_acks = jnp.where(
+            keep_r[:, None, :], s.read_acks | bitplane.pack(aresp, axis=1), zw
+        )
+        if cfg.read_confirm:
+            serve = keep_r & inp.alive & packed_quorum(read_acks | eye_p3)
+        else:
+            serve = keep_r & inp.alive  # TEST-ONLY mutant: no confirmation
+        lat_r = jnp.maximum(s.now[None, :] + 1 - s.read_tick, 1)  # [N, B]
+        reads_served = jnp.sum(serve, axis=0).astype(jnp.int32)
+        read_lat_sum = jnp.sum(jnp.where(serve, lat_r, 0), axis=0).astype(jnp.int32)
+        bin_r = log_ops.log2_bin(lat_r, LAT_HIST_BINS)
+        oh_r = (
+            iota((1, LAT_HIST_BINS, 1), 1) == bin_r[:, None, :]
+        ) & serve[:, None, :]
+        read_hist = jnp.sum(oh_r, axis=0).astype(jnp.int32)  # [BINS, B]
+        if comp:
+            cur_committed = (
+                log_ops.term_at_rb(log_term_arr, base, bterm, commit) == term
+            )
+        else:
+            cur_committed = log_ops.term_at_b(log_term_arr, commit) == term
+        can_cap = (inp.read_cmd != NIL)[None, :] & is_leader & inp.alive & ~pend0
+        if cfg.read_confirm:
+            can_cap = can_cap & cur_committed
+        if xfr:
+            can_cap = can_cap & ~xfer_pend
+        low_cap = jnp.min(jnp.where(can_cap, ids2, n), axis=0)  # [B]
+        cap_r = can_cap & (ids2 == low_cap[None, :])
+        cleared = serve | (pend0 & ~keep_r)
+        read_idx = jnp.where(cap_r, commit + 1, jnp.where(cleared, 0, s.read_idx))
+        read_tick = jnp.where(
+            cap_r, s.now[None, :] + 1, jnp.where(cleared, 0, s.read_tick)
+        )
+        read_acks = jnp.where((cap_r | serve)[:, None, :], zw, read_acks)
+    else:
+        # Constants, not jnp.zeros: keep the disabled-mode lowered program
+        # byte-identical (see raft.py).
+        reads_served = np.zeros((b,), np.int32)
+        read_lat_sum = np.zeros((b,), np.int32)
+        read_hist = np.zeros((LAT_HIST_BINS, b), np.int32)
 
     # ---- offer->commit latency (client workloads only; raft.py) ------------------
     if track:
@@ -463,14 +656,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         lat_excluded = jnp.maximum(
             jnp.sum(crossed, axis=(0, 1)).astype(jnp.int32) - lat_cnt, 0
         )
-        # Histogram bin = floor(log2(l)) via unrolled bit-length (raft.py).
-        bl = jnp.zeros_like(lats)
-        v = lats
-        for sft in (16, 8, 4, 2, 1):
-            m_ = v >= (1 << sft)
-            bl = bl + m_ * sft
-            v = jnp.where(m_, v >> sft, v)
-        bin_ = jnp.minimum(bl, LAT_HIST_BINS - 1)
+        # Histogram bin = floor(log2(l)) (log_ops.log2_bin; raft.py).
+        bin_ = log_ops.log2_bin(lats, LAT_HIST_BINS)
         oh_b = (iota((1, 1, LAT_HIST_BINS, 1), 2) == bin_[:, :, None, :]) & lm[:, :, None, :]
         lat_hist = jnp.sum(oh_b, axis=(0, 1)).astype(jnp.int32)  # [BINS, B]
         lat_frontier = jnp.maximum(s.lat_frontier, jnp.max(commit, axis=0))
@@ -539,6 +726,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         tgt_oh = active[:, None, :] & (tgt[:, None, :] == iota((1, n, 1), 1))  # [K, N, B]
         low_k = jnp.min(jnp.where(tgt_oh, kk3, kdim), axis=0)  # [N, B]
         node_ok = is_leader & inp.alive & room & ~noop  # [N, B]
+        if xfr:
+            node_ok = node_ok & ~xfer_pend  # transfer lease handoff (raft.py)
         client_ok = (low_k < kdim) & node_ok  # [N, B] nodes accepting a slot
         sel_k = tgt_oh & (kk3 == low_k[None, :, :]) & node_ok[None, :, :]  # [K, N, B]
         wval_cl = jnp.sum(jnp.where(sel_k, pend[:, None, :], 0), axis=0)  # [N, B]
@@ -557,6 +746,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         client_tick = jnp.where(pend_on, ptick, 0) if track else s.client_tick
     else:
         client_ok = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive & room & ~noop
+        if xfr:
+            client_ok = client_ok & ~xfer_pend  # transfer lease handoff
         wval_cl = jnp.broadcast_to(inp.client_cmd[None, :], (n, b))
         # Direct mode accepts on the offer tick: stamp = now + 1 (raft.py).
         wtick_cl = (
@@ -595,14 +786,34 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         # Expiry starts a PRE-vote probe: no term bump, votedFor untouched
         # (raft.py phase 7); real elections start at promotions (phase 4.5).
         start_prevote = expired & ~is_leader
+        if rcf:
+            start_prevote = start_prevote & member_b2  # non-voters never campaign
+        if xfr:
+            start_prevote = start_prevote & ~xfer_elect  # thesis-3.10 bypass
         role = jnp.where(start_prevote, PRECANDIDATE, role)
         leader_id = jnp.where(start_prevote, NIL, leader_id)
         votes = jnp.where(start_prevote[:, None, :], eye_p3, votes)
         deadline = jnp.where(start_prevote, clock + inp.timeout_draw, deadline)
         start_election = pre_win
+        if xfr:
+            # TimeoutNow election (raft.py phase 7): the real-election start
+            # minus the pre-quorum; ~is_leader re-checked (a phase-4 win may
+            # have promoted the target this very tick).
+            xe = xfer_elect & ~pre_win & ~is_leader
+            term = term + xe
+            role = jnp.where(xe, CANDIDATE, role)
+            voted_for = jnp.where(xe, ids2, voted_for)
+            leader_id = jnp.where(xe, NIL, leader_id)
+            votes = jnp.where(xe[:, None, :], eye_p3, votes)
+            deadline = jnp.where(xe, clock + inp.timeout_draw, deadline)
+            start_election = pre_win | xe
     else:
         start_prevote = jnp.zeros_like(expired)
         start_election = expired & ~is_leader
+        if rcf:
+            start_election = start_election & member_b2  # non-voters never campaign
+        if xfr:
+            start_election = start_election | (xfer_elect & ~is_leader)
         term = term + start_election
         role = jnp.where(start_election, CANDIDATE, role)
         voted_for = jnp.where(start_election, ids2, voted_for)
@@ -632,6 +843,23 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_req_term = jnp.where(out_req_type != 0, term, 0)
     if cfg.pre_vote:
         out_req_term = jnp.where(start_prevote, term + 1, out_req_term)  # prospective
+    if xfr:
+        # TimeoutNow fire (raft.py phase 8): replaces the heartbeat slot on
+        # catch-up; AE window fields stay populated (receivers gate on
+        # req_type == REQ_APPEND).
+        tgt_oh8 = iota((1, n, 1), 1) == jnp.clip(xfer_to, 0, n - 1)[:, None, :]
+        t_match = jnp.sum(
+            jnp.where(tgt_oh8, match_index, 0), axis=1, dtype=jnp.int32
+        )
+        if cfg.xfer_election:
+            caught = t_match >= log_len
+        else:
+            caught = jnp.ones_like(log_len, bool)  # TEST-ONLY mutant: no wait
+        fire = send_append & (xfer_to != NIL) & caught
+        out_req_type = jnp.where(fire, REQ_TIMEOUT_NOW, out_req_type)
+        out_xfer_tgt = jnp.where(fire, xfer_to, NIL).astype(jnp.int8)
+    else:
+        out_xfer_tgt = mb.xfer_tgt  # NIL, loop-invariant carry component
     prev_out = jnp.clip(next_index - 1, 0, len_i[:, None, :])  # [src, dst, B]
     # Shared window start: minimum prev over RESPONSIVE peers, falling back to all
     # peers when none are (see raft.py phase 8 for the liveness argument).
@@ -722,6 +950,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         req_base_chk=(
             jnp.where(send_append, bchk, jnp.uint32(0)) if comp else mb.req_base_chk
         ),
+        xfer_tgt=out_xfer_tgt,
         req_off=out_req_off,
         resp_kind=out_resp_kind,
         pv_grant=out_pv_grant,
@@ -764,6 +993,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         clock=clock,
         deadline=deadline,
         heard_clock=heard,
+        member_old=m_old3 if rcf else s.member_old,
+        member_new=m_new2 if rcf else s.member_new,
+        cfg_epoch=cfg_epoch if rcf else s.cfg_epoch,
+        cfg_pend=cfg_pend if rcf else s.cfg_pend,
+        xfer_to=xfer_to if xfr else s.xfer_to,
+        read_idx=read_idx if rdx else s.read_idx,
+        read_tick=read_tick if rdx else s.read_tick,
+        read_acks=read_acks if rdx else s.read_acks,
         client_pend=client_pend,
         client_dst=client_dst,
         client_tick=client_tick,
@@ -775,6 +1012,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     info = _step_info_b(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
+        reads_served, read_lat_sum, read_hist,
     )
     return new_state, info
 
@@ -793,6 +1031,9 @@ def _step_info_b(
     lat_hist: jax.Array,
     lat_excluded: jax.Array,
     noop_blocked: jax.Array,
+    reads_served: jax.Array,
+    read_lat_sum: jax.Array,
+    read_hist: jax.Array,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
@@ -912,4 +1153,7 @@ def _step_info_b(
         lat_excluded=lat_excluded,
         noop_blocked=noop_blocked,
         lm_skipped_pairs=lm_skipped,
+        reads_served=reads_served,
+        read_lat_sum=read_lat_sum,
+        read_hist=read_hist,
     )

@@ -133,6 +133,22 @@ def eye(n: int) -> jax.Array:
     return pack(jnp.eye(n, dtype=bool), axis=1)
 
 
+def one_bit(i: jax.Array, n: int) -> jax.Array:
+    """[W] uint32 word row with only (traced) bit `i` set: the dynamic
+    counterpart of `bit_row`. Broadcasts: a batched `i` of shape [B] yields
+    [W, B] rows (the membership-plane layout of the reconfiguration plane,
+    raft_sim_tpu/reconfig). Out-of-range `i` (e.g. the NIL sentinel) yields
+    the all-zero row, so callers can feed sentinels unguarded."""
+    i = jnp.asarray(i, jnp.int32)
+    w = jnp.arange(n_words(n), dtype=jnp.int32).reshape(
+        (n_words(n),) + (1,) * i.ndim
+    )  # [W, *i.shape]
+    hit = (w == i // WORD) & (i >= 0)[None] & (i < n)[None]
+    return jnp.where(
+        hit, jnp.uint32(1) << (i % WORD).astype(jnp.uint32)[None], jnp.uint32(0)
+    )
+
+
 def set_bit(plane: jax.Array, row, col, value: bool = True) -> jax.Array:
     """Set (or clear) single bit `col` of `plane[row]` on a [N, W] packed plane.
     Test/state-surgery helper; kernels use the word algebra directly."""

@@ -236,6 +236,21 @@ def ring_chk(log_term, log_val, base, uptos: tuple):
 # --------------------------------------------------------------------------------------
 
 
+def log2_bin(v: jax.Array, n_bins: int) -> jax.Array:
+    """Elementwise floor(log2(v)) clamped to [0, n_bins): the latency
+    histogram's bin index (types.LAT_HIST_BINS semantics), via an unrolled
+    binary bit-length reduction -- no float log in any hot loop. The ONE
+    copy both kernels' commit-latency AND read-latency histograms bin with
+    (four call sites; a binning change is one edit). v must be >= 0; v in
+    {0, 1} lands in bin 0."""
+    bl = jnp.zeros_like(v)
+    for sft in (16, 8, 4, 2, 1):
+        m_ = v >= (1 << sft)
+        bl = bl + m_ * sft
+        v = jnp.where(m_, v >> sft, v)
+    return jnp.minimum(bl, n_bins - 1)
+
+
 def iota(shape, d):
     """int32 iota built at its final rank. The single shared helper for all batched
     kernels: Mosaic (Pallas TPU) cannot lower the unit-dim-appending reshapes that

@@ -184,6 +184,14 @@ class FleetSummary(NamedTuple):
     # that has not heard the news); the graded precursor the scenario search
     # climbs toward election-safety violations (docs/SCENARIOS.md).
     multi_leader: int
+    # ReadIndex read traffic (RunMetrics.reads_served/read_hist; zeros unless
+    # cfg.read_index): reads served fleet-wide and their true per-read
+    # latency percentiles -- the commit-vs-read comparison the read traffic
+    # class exists to expose (docs/PROTOCOL.md).
+    reads_served: int
+    read_p50: float | None
+    read_p95: float | None
+    read_p99: float | None
 
 
 def gather_metrics(metrics):
@@ -248,12 +256,17 @@ def _latency_rollup(m) -> dict:
         else None
     )
     hist = np.sum(np.asarray(m.lat_hist, dtype=np.int64), axis=0)  # [BINS]
+    rhist = np.sum(np.asarray(m.read_hist, dtype=np.int64), axis=0)  # [BINS]
     return {
         "p50_commit_latency": p50_lat,  # legacy (see FleetSummary docstring)
         "lat_p50": _hist_percentile(hist, 0.50),
         "lat_p95": _hist_percentile(hist, 0.95),
         "lat_p99": _hist_percentile(hist, 0.99),
         "lat_excluded": int(np.sum(m.lat_excluded, dtype=np.int64)),
+        "reads_served": int(np.sum(m.reads_served, dtype=np.int64)),
+        "read_p50": _hist_percentile(rhist, 0.50),
+        "read_p95": _hist_percentile(rhist, 0.95),
+        "read_p99": _hist_percentile(rhist, 0.99),
     }
 
 

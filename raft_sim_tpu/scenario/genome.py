@@ -46,6 +46,14 @@ class ScenarioGenome(NamedTuple):
     crash_down: jax.Array  # [S] int32: max down-span ticks (uniform 1..this)
     skew: jax.Array  # [S] uint32: clock-skew threshold (half stall, half jump)
     client_interval: jax.Array  # [S] int32: client offer cadence (0 = none)
+    # Reconfiguration-plane cadences (raft_sim_tpu/reconfig): membership
+    # change / leadership transfer / ReadIndex read offers. Tuning knobs like
+    # client_interval -- the STRUCTURAL gate stays on RaftConfig
+    # (reconfig_interval/transfer_interval/read_interval > 0), the genome
+    # retimes commands within it (validate() enforces the pairing).
+    reconfig_interval: jax.Array  # [S] int32: membership-toggle cadence (0 = none)
+    transfer_interval: jax.Array  # [S] int32: leadership-transfer cadence (0 = none)
+    read_interval: jax.Array  # [S] int32: ReadIndex offer cadence (0 = none)
 
 
 # The threshold-encoded (uint32) fields; everything else is int32. The ONE
@@ -70,6 +78,9 @@ def segment(
     crash_down_ticks: int = 1,
     clock_skew_prob: float = 0.0,
     client_interval: int = 0,
+    reconfig_interval: int = 0,
+    transfer_interval: int = 0,
+    read_interval: int = 0,
 ) -> dict:
     """One segment's parameters in HUMAN units (probabilities as floats),
     encoded to the genome's integer fields. The declarative scenario-file
@@ -82,6 +93,9 @@ def segment(
         "crash_down": int(crash_down_ticks),
         "skew": p_to_u32(clock_skew_prob),
         "client_interval": int(client_interval),
+        "reconfig_interval": int(reconfig_interval),
+        "transfer_interval": int(transfer_interval),
+        "read_interval": int(read_interval),
     }
 
 
@@ -117,6 +131,9 @@ def from_config(cfg: RaftConfig) -> ScenarioGenome:
             crash_down_ticks=cfg.crash_down_ticks if cfg.crash_prob > 0 else 1,
             clock_skew_prob=cfg.clock_skew_prob,
             client_interval=cfg.client_interval,
+            reconfig_interval=cfg.reconfig_interval,
+            transfer_interval=cfg.transfer_interval,
+            read_interval=cfg.read_interval,
         )
     ])
 
@@ -165,6 +182,22 @@ def validate(cfg: RaftConfig, genome: ScenarioGenome) -> None:
             "compiles in when the config carries a client workload) -- set a "
             "nonzero cfg.client_interval as the base cadence the genome tunes"
         )
+    for field, gate, knob in (
+        ("reconfig_interval", cfg.reconfig, "reconfig_interval"),
+        ("transfer_interval", cfg.leader_transfer, "transfer_interval"),
+        ("read_interval", cfg.read_index, "read_interval"),
+    ):
+        v = np.asarray(getattr(genome, field))
+        if (v < 0).any():
+            raise ValueError(f"{field} must be >= 0 (0 disables the stream)")
+        if (v > 0).any() and not gate:
+            raise ValueError(
+                f"genome drives {field} but the config's {knob} is 0: the "
+                "reconfiguration-plane handlers are STRUCTURAL gates (they "
+                "only compile in when the config enables the extension) -- "
+                f"set a nonzero cfg.{knob} as the base cadence the genome "
+                "tunes (docs/PROTOCOL.md)"
+            )
 
 
 def decode(genome: ScenarioGenome) -> list[dict]:
@@ -181,6 +214,9 @@ def decode(genome: ScenarioGenome) -> list[dict]:
             "crash_down_ticks": int(g["crash_down"][i]),
             "clock_skew_prob": round(float(g["skew"][i]) / U32_SPAN, 9),
             "client_interval": int(g["client_interval"][i]),
+            "reconfig_interval": int(g["reconfig_interval"][i]),
+            "transfer_interval": int(g["transfer_interval"][i]),
+            "read_interval": int(g["read_interval"][i]),
         }
         for i in range(s_count)
     ]
@@ -192,11 +228,28 @@ def to_raw(genome: ScenarioGenome) -> dict:
     return {f: np.asarray(getattr(genome, f)).tolist() for f in genome._fields}
 
 
+# The only fields from_raw may backfill when absent: pre-v22 artifacts
+# predate the reconfiguration-plane cadences, and an absent cadence decodes
+# as the all-zero (disabled) stream -- which reproduces the old trajectory
+# exactly (disabled cadences draw nothing). CORE fields stay strict: a
+# missing one is artifact corruption and must raise, not silently replay a
+# different scenario.
+_OPTIONAL_FIELDS = frozenset(
+    {"reconfig_interval", "transfer_interval", "read_interval"}
+)
+
+
 def from_raw(raw: dict) -> ScenarioGenome:
-    """Inverse of to_raw: rebuild the exact genome from artifact integers."""
+    """Inverse of to_raw: rebuild the exact genome from artifact integers
+    (see _OPTIONAL_FIELDS for the pre-v22 compatibility rule)."""
+    shape = np.asarray(raw["drop"]).shape
+    zeros = np.zeros(shape, dtype=int).tolist()
     return ScenarioGenome(
         **{
-            f: jnp.asarray(raw[f], leaf_dtype(f))
+            f: jnp.asarray(
+                raw.get(f, zeros) if f in _OPTIONAL_FIELDS else raw[f],
+                leaf_dtype(f),
+            )
             for f in ScenarioGenome._fields
         }
     )

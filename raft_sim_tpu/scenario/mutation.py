@@ -32,7 +32,51 @@ class WeakQuorumConfig(RaftConfig):
         return self.n_nodes // 2
 
 
-MUTANTS = {"weak-quorum": WeakQuorumConfig}
+class JointBypassConfig(RaftConfig):
+    """One-step membership change: toggles apply to BOTH configurations
+    instantly, no joint phase (cfg.joint_consensus False). Consecutive
+    changes under replication lag then produce commit quorums and election
+    quorums that do not intersect, so a leader missing committed entries gets
+    elected and replicates its short log over them -- the thesis-4.3
+    motivating bug. Requires cfg.reconfig (reconfig_interval > 0)."""
+
+    @property
+    def joint_consensus(self) -> bool:  # type: ignore[override]
+        return False
+
+
+class StaleReadConfig(RaftConfig):
+    """ReadIndex without the confirmation round OR the current-term-commit
+    capture gate (cfg.read_confirm False): a deposed leader stranded in a
+    minority partition keeps serving reads from its stale commit state --
+    reads below the committed frontier, the linearizability break the trace
+    checker's read_linearizability property must reject. Requires
+    cfg.read_index (read_interval > 0)."""
+
+    @property
+    def read_confirm(self) -> bool:  # type: ignore[override]
+        return False
+
+
+class BlindTransferConfig(RaftConfig):
+    """TimeoutNow as a coup (cfg.xfer_election False): the leader fires
+    without waiting for the target to catch up, and the target assumes
+    leadership DIRECTLY -- no vote round, no up-to-date check -- so a behind
+    target truncates committed entries off its followers (commit-invariant /
+    leader-completeness breaks). Requires cfg.leader_transfer
+    (transfer_interval > 0)."""
+
+    @property
+    def xfer_election(self) -> bool:  # type: ignore[override]
+        return False
+
+
+MUTANTS = {
+    "weak-quorum": WeakQuorumConfig,
+    "joint-bypass": JointBypassConfig,
+    "stale-read": StaleReadConfig,
+    "blind-transfer": BlindTransferConfig,
+}
 
 
 def mutant_config(name: str, cfg: RaftConfig) -> RaftConfig:

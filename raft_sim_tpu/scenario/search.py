@@ -125,8 +125,16 @@ class SearchSpec:
 
 
 def _decode_row(cfg: RaftConfig, knobs, x: np.ndarray) -> genome_mod.ScenarioGenome:
-    """One normalized knob vector -> an [S=1] genome segment."""
-    params = {"client_interval": cfg.client_interval}
+    """One normalized knob vector -> an [S=1] genome segment. Workload
+    cadences (client traffic AND the reconfiguration-plane admin streams)
+    stay pinned to cfg: the workload is part of the question, not the
+    answer -- the hunt searches the FAULT space around it."""
+    params = {
+        "client_interval": cfg.client_interval,
+        "reconfig_interval": cfg.reconfig_interval,
+        "transfer_interval": cfg.transfer_interval,
+        "read_interval": cfg.read_interval,
+    }
     for k, xi in zip(knobs, x):
         v = k.lo + float(xi) * (k.hi - k.lo)
         params[k.name] = int(round(v)) if k.kind == "int" else v

@@ -44,6 +44,9 @@ VIOL_FIELDS = ("viol_election_safety", "viol_commit", "viol_log_matching")
 ABLATIONS = (
     ("clock skew", {"skew": 0}),
     ("client traffic", {"client_interval": 0}),
+    ("leadership transfers", {"transfer_interval": 0}),
+    ("reads", {"read_interval": 0}),
+    ("membership changes", {"reconfig_interval": 0}),
     ("message drop", {"drop": 0}),
     ("partitions", {"part": 0, "part_period": 0}),
     ("crashes", {"crash": 0}),

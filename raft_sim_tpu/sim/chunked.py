@@ -42,6 +42,9 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
         lat_excluded=a.lat_excluded + b.lat_excluded,
         noop_blocked=a.noop_blocked + b.noop_blocked,
         lm_skipped_pairs=a.lm_skipped_pairs + b.lm_skipped_pairs,
+        reads_served=a.reads_served + b.reads_served,
+        read_lat_sum=a.read_lat_sum + b.read_lat_sum,
+        read_hist=a.read_hist + b.read_hist,
         multi_leader=a.multi_leader + b.multi_leader,
         ticks=a.ticks + b.ticks,
     )

@@ -35,8 +35,10 @@ per-cluster `ScenarioGenome` of traced `[S]`-segment fault parameters through
 through the SAME helpers from the SAME key streams, a genome that replicates
 the config scalars reproduces the scalar path's trajectories BIT-FOR-BIT
 (tests/test_scenario.py pins this). The genome is duck-typed here (fields
-`drop/part_period/part/crash/crash_down/skew/client_interval`, each a `[S]`
-per-segment leaf -- see scenario/genome.py); sim/ never imports scenario/.
+`drop/part_period/part/crash/crash_down/skew/client_interval` plus the
+reconfiguration-plane cadences `reconfig_interval/transfer_interval/
+read_interval`, each a `[S]` per-segment leaf -- see scenario/genome.py);
+sim/ never imports scenario/.
 
 The per-cluster key is split once into disjoint streams (per-tick draws, per-cluster
 drop rate, per-window partition layout) so no fold_in value can collide across
@@ -142,6 +144,47 @@ def _skew_draw(n: int, k_skew: jax.Array, skew_t) -> jax.Array:
     return jnp.where(r < (skew_t >> 1), 0, jnp.where(r < skew_t, 2, 1)).astype(
         jnp.int32
     )
+
+
+def _admin_cmds(cfg: RaftConfig, tkey: jax.Array, now: jax.Array,
+                rcfg_i, xfer_i, read_i, traced: bool):
+    """(reconfig_cmd, transfer_cmd, read_cmd) draws -- the reconfiguration
+    plane's admin offers (raft_sim_tpu/reconfig). Each cadence follows the
+    client_interval pattern: `*_i` is a Python int on the scalar path
+    (statically gated so disabled planes draw nothing) and traced genome data
+    on the scenario path (`traced=True`: every command stream is computed
+    unconditionally from the SAME dedicated key stream, so a homogeneous
+    genome reproduces the scalar path bit-for-bit). Targets rotate randomly
+    over nodes -- add/remove-under-fire and transfer-under-fire programs are
+    target-diverse by default."""
+    n = cfg.n_nodes
+    k_rcfg, k_xfer = jax.random.split(jax.random.fold_in(tkey, 5))
+    # Disabled planes return a TRACED NIL scalar, not the Python-int NIL the
+    # StepInputs defaults use: these leaves flow through vmap (which would
+    # broadcast a Python int into a real [B] array anyway -- no saving) and
+    # the analyzer's eval_shape pricing (which needs shaped leaves). Cost:
+    # the Pass C input-accounting prices 3x int32 = 12 B/cluster-tick on
+    # every tier; the VALUES are loop constants XLA folds, and the kernels
+    # never read them when the gate is off (the carry stays untouched).
+    nil = jnp.int32(NIL)
+    if traced or cfg.reconfig:
+        on = (rcfg_i > 0) & (now % jnp.maximum(rcfg_i, 1) == 0) & (now > 0)
+        tgt = jax.random.randint(k_rcfg, (), 0, n)
+        reconfig_cmd = jnp.asarray(jnp.where(on, tgt, NIL), jnp.int32)
+    else:
+        reconfig_cmd = nil
+    if traced or cfg.leader_transfer:
+        on = (xfer_i > 0) & (now % jnp.maximum(xfer_i, 1) == 0) & (now > 0)
+        tgt = jax.random.randint(k_xfer, (), 0, n)
+        transfer_cmd = jnp.asarray(jnp.where(on, tgt, NIL), jnp.int32)
+    else:
+        transfer_cmd = nil
+    if traced or cfg.read_index:
+        on = (read_i > 0) & (now % jnp.maximum(read_i, 1) == 0)
+        read_cmd = jnp.asarray(jnp.where(on, 1, NIL), jnp.int32)
+    else:
+        read_cmd = nil
+    return reconfig_cmd, transfer_cmd, read_cmd
 
 
 def _client_routing(cfg: RaftConfig, tkey: jax.Array):
@@ -267,6 +310,10 @@ def make_inputs(
         # evaluated under the NEW segment's crash parameters (deterministic
         # and replayable; documented in docs/SCENARIOS.md).
         restarted = alive & ~_alive_at_t(cfg, ckey, now - 1, g.crash, g.crash_down)
+        reconfig_cmd, transfer_cmd, read_cmd = _admin_cmds(
+            cfg, tkey, now, g.reconfig_interval, g.transfer_interval,
+            g.read_interval, traced=True,
+        )
     else:
         # Message drop (the reference's silently-dropped RPC, client.clj:38-40).
         if cfg.drop_prob > 0:
@@ -323,6 +370,11 @@ def make_inputs(
             alive = jnp.ones((n,), bool)
             restarted = jnp.zeros((n,), bool)
 
+        reconfig_cmd, transfer_cmd, read_cmd = _admin_cmds(
+            cfg, tkey, now, cfg.reconfig_interval, cfg.transfer_interval,
+            cfg.read_interval, traced=False,
+        )
+
     return StepInputs(
         # Shipped bit-packed over the source axis (StepInputs docstring): the
         # same Bernoulli/partition draws, 32 edges per uint32 word -- the [N, N]
@@ -335,4 +387,7 @@ def make_inputs(
         client_bounce=client_bounce,
         alive=alive,
         restarted=restarted,
+        reconfig_cmd=reconfig_cmd,
+        transfer_cmd=transfer_cmd,
+        read_cmd=read_cmd,
     )

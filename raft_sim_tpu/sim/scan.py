@@ -67,6 +67,14 @@ class RunMetrics(NamedTuple):
     # Liveness/coverage counters (StepInfo.noop_blocked / lm_skipped_pairs).
     noop_blocked: jax.Array  # int32: election wins denied their no-op slot
     lm_skipped_pairs: jax.Array  # int32: pair-checks skipped by ring log matching
+    # ReadIndex read traffic (StepInfo.reads_served/read_lat_sum/read_hist;
+    # zeros unless cfg.read_index): reads served, their summed offer->serve
+    # latency, and the log2-bin read-latency histogram -- the read-side
+    # mirror of lat_sum/lat_cnt/lat_hist, so telemetry reports commit-vs-read
+    # latency from one schema.
+    reads_served: jax.Array  # int32
+    read_lat_sum: jax.Array  # int32
+    read_hist: jax.Array  # [LAT_HIST_BINS] int32
     # Split-brain exposure: ticks with >= 2 concurrent LEADER roles
     # (StepInfo.n_leaders). LEGAL under partitions (a deposed leader has not
     # heard the news yet) -- only SAME-term double leadership violates
@@ -103,9 +111,28 @@ def init_metrics() -> RunMetrics:
         lat_excluded=z,
         noop_blocked=z,
         lm_skipped_pairs=z,
+        reads_served=z,
+        read_lat_sum=z,
+        read_hist=jnp.zeros((LAT_HIST_BINS,), jnp.int32),
         multi_leader=z,
         ticks=z,
     )
+
+
+def _host_zero(x) -> bool:
+    """True for a host-side constant zero StepInfo leaf: the kernels emit np
+    constants (never jnp.zeros, which would lower an op) for metrics whose
+    structural gate is off, and skipping the fold keeps the corresponding
+    RunMetrics carry leg loop-invariant -- XLA elides it from the per-tick
+    HBM round trip and the Pass C cost gate prices it at zero
+    (zero-cost-when-off, the same contract the state legs follow)."""
+    import numpy as np  # host-side predicate only; jnp arrays fall through
+
+    return isinstance(x, (int, np.integer, np.ndarray)) and not np.any(x)
+
+
+def _add_gated(a, b):
+    return a if _host_zero(b) else a + b
 
 
 def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
@@ -130,6 +157,9 @@ def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
         lat_excluded=m.lat_excluded + info.lat_excluded,
         noop_blocked=m.noop_blocked + info.noop_blocked,
         lm_skipped_pairs=m.lm_skipped_pairs + info.lm_skipped_pairs,
+        reads_served=_add_gated(m.reads_served, info.reads_served),
+        read_lat_sum=_add_gated(m.read_lat_sum, info.read_lat_sum),
+        read_hist=_add_gated(m.read_hist, info.read_hist),
         multi_leader=m.multi_leader + (info.n_leaders >= 2),
         ticks=m.ticks + 1,
     )
@@ -237,7 +267,7 @@ def run_batch_minor(
 
 def tick_batch_minor(
     cfg, s, keys, metrics, step_fn=None, client_cmd=None, genome=None, seg_len=1,
-    events=False,
+    events=False, read_cmd=None,
 ):
     """ONE tick of the batch-minor path: input generation, step, metric
     accumulation. `s` is batch-minor; `keys` keep their [B]-leading layout (input
@@ -270,6 +300,12 @@ def tick_batch_minor(
         )(keys, s.now, genome)
     if client_cmd is not None:
         inp = inp._replace(client_cmd=jnp.full_like(inp.client_cmd, client_cmd))
+    if read_cmd is not None:
+        # External ReadIndex ingest (the read-only traffic class riding the
+        # serve path beside offered writes): overrides the scheduled
+        # read cadence for this tick, exactly like client_cmd above. The
+        # config must carry the structural gate (cfg.read_index).
+        inp = inp._replace(read_cmd=jnp.full_like(inp.read_cmd, read_cmd))
     inp_t = raft_batched.to_batch_minor(inp)
     s2, info = step_fn(cfg, s, inp_t)
     m2 = _accumulate(metrics, info, s.now)  # all fields [B]: elementwise

@@ -104,6 +104,9 @@ def init_recorder(cfg: RaftConfig, k: int, batch: int) -> FlightRecorder:
         lat_excluded=leaf(jnp.int32),
         noop_blocked=leaf(jnp.int32),
         lm_skipped_pairs=leaf(jnp.int32),
+        reads_served=leaf(jnp.int32),
+        read_lat_sum=leaf(jnp.int32),
+        read_hist=leaf(jnp.int32, LAT_HIST_BINS),
     )
     return FlightRecorder(
         ring=ring,

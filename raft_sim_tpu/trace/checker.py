@@ -55,12 +55,20 @@ import json
 from raft_sim_tpu.trace import events as tev
 from raft_sim_tpu.trace.history import Event, History
 
+# Same-term leader elections closer than this many configuration-epoch bumps
+# always share a voter (see _check_cluster): 4 = two completed joint cycles,
+# the minimum separation at which two single-config majorities can be
+# disjoint. Conservative for mutant kernels that bump epochs without joint
+# phases -- their signature also fires commit/completeness properties.
+EPOCH_EXEMPT_DISTANCE = 4
+
 PROPERTIES = (
     "election_safety",
     "leader_append_only",
     "log_matching",
     "leader_completeness",
     "state_machine_safety",
+    "read_linearizability",
 )
 
 
@@ -104,28 +112,73 @@ class CheckReport:
 def _check_cluster(c: int, evs: list[Event], fail) -> None:
     """Replay one cluster's timeline; report violations via fail(prop,
     witness_events, note)."""
-    leaders_by_term: dict[int, Event] = {}
+    # Election safety is scoped per CONFIGURATION EPOCH DISTANCE (EV_EPOCH
+    # events, raft_sim_tpu/reconfig): under the admin-driven membership
+    # model two leaders may legally hold one term number across DISTANT
+    # epochs (their electorates need not overlap once the configuration
+    # moved far enough), but any two single-configuration majorities less
+    # than two full joint cycles apart provably intersect -- one toggle
+    # changes the member set by a single node, and maj(M) + maj(M ^ {v}) >
+    # |M union {v}| for both add and remove, while a joint epoch's DUAL
+    # electorate intersects both its neighbors by construction. Two full
+    # cycles = 4 epoch bumps (enter, exit, enter, exit), so same-term
+    # leaders with epoch distance < EPOCH_EXEMPT_DISTANCE always imply a
+    # double-voted node: a genuine violation. Epoch transitions replay at
+    # end-of-tick (cluster-scope kinds order last), matching the kernel's
+    # phase order (elections precede the phase-5.2 transition). Without the
+    # reconfiguration plane no EV_EPOCH ever fires and the scope is the
+    # whole run -- exactly the old behavior.
+    epoch = 0
+    leaders_by_term: dict[int, list[tuple[int, Event]]] = {}  # term -> [(epoch, ev)]
     leader_set: dict[int, Event] = {}  # node -> its EV_LEADER event
     frontier = 0
     frontier_ev: Event | None = None
     last_commit: dict[int, tuple[int, Event]] = {}
     restarted_since: dict[int, bool] = {}
+    # ReadIndex linearizability: a read captured at issue time must cover the
+    # committed frontier AS OF ISSUE (every write committed anywhere before
+    # the read began) -- checked when the read is SERVED, because a stale
+    # leader legally captures a stale index it can never confirm (the real
+    # kernel's quorum round kills it; only a served stale read violates).
+    pending_reads: dict[int, tuple[int, int, Event]] = {}  # node -> (idx, frontier, ev)
     for e in evs:
         k = e.kind
         if k in (tev.EV_FOLLOWER, tev.EV_PRECANDIDATE, tev.EV_CANDIDATE):
             leader_set.pop(e.node, None)
+        elif k == tev.EV_EPOCH:
+            epoch = e.detail
+        elif k == tev.EV_READ_ISSUE:
+            pending_reads[e.node] = (e.detail, frontier, e)
+        elif k == tev.EV_READ_SERVE:
+            pend = pending_reads.pop(e.node, None)
+            if pend is not None and e.detail < pend[1]:
+                fail(
+                    "read_linearizability", [pend[2], e],
+                    f"cluster {c}: node {e.node} served a ReadIndex read at "
+                    f"index {e.detail} (issued tick {pend[2].tick}) below the "
+                    f"committed frontier {pend[1]} at issue time: the read "
+                    "misses committed writes",
+                )
         elif k == tev.EV_LEADER:
             term = e.detail
-            prior = leaders_by_term.get(term)
+            prior = next(
+                (
+                    (pe, pev)
+                    for pe, pev in leaders_by_term.get(term, [])
+                    if abs(epoch - pe) < EPOCH_EXEMPT_DISTANCE
+                ),
+                None,
+            )
             if prior is not None:
                 fail(
-                    "election_safety", [prior, e],
-                    f"cluster {c}: two leaders elected for term {term} "
-                    f"(node {prior.node} at tick {prior.tick}, node {e.node} "
-                    f"at tick {e.tick})",
+                    "election_safety", [prior[1], e],
+                    f"cluster {c}: two leaders elected for term {term} in "
+                    f"config epochs {prior[0]}/{epoch} -- electorates less "
+                    f"than {EPOCH_EXEMPT_DISTANCE} epoch bumps apart always "
+                    f"intersect (node {prior[1].node} at tick "
+                    f"{prior[1].tick}, node {e.node} at tick {e.tick})",
                 )
-            else:
-                leaders_by_term[term] = e
+            leaders_by_term.setdefault(term, []).append((epoch, e))
             leader_set[e.node] = e
         elif k == tev.EV_TRUNCATE:
             led = leader_set.get(e.node)

@@ -71,9 +71,21 @@ EV_TRUNCATE = 9
 EV_CRASH = 10
 EV_RESTART = 11
 EV_DROP = 12
-EV_VIOLATION = 13
-EV_PARTITION = 14
-N_KINDS = 15
+# Reconfiguration-plane kinds (raft_sim_tpu/reconfig). Read kinds sit ABOVE
+# the commit kind on purpose: a read served this tick is checked against
+# commits that landed this tick (the kernel serves against the
+# post-advancement commit), so the checker must replay commit before serve;
+# EV_EPOCH rides cluster scope at end-of-tick, matching the kernel's phase
+# order (elections precede the phase-5.2 configuration transition). detail
+# semantics: xfer = target node; read issue/serve = the captured read index;
+# epoch = the new configuration epoch.
+EV_XFER = 13
+EV_READ_ISSUE = 14
+EV_READ_SERVE = 15
+EV_VIOLATION = 16
+EV_PARTITION = 17
+EV_EPOCH = 18
+N_KINDS = 19
 
 KINDS = {
     "follower": EV_FOLLOWER,
@@ -90,17 +102,22 @@ KINDS = {
     "drop": EV_DROP,
     "violation": EV_VIOLATION,
     "partition": EV_PARTITION,
+    "xfer": EV_XFER,
+    "read_issue": EV_READ_ISSUE,
+    "read_serve": EV_READ_SERVE,
+    "epoch": EV_EPOCH,
 }
 KIND_NAMES = {v: k for k, v in KINDS.items()}
 
-# Per-NODE kinds in slot order; the two cluster-scope kinds follow them with
+# Per-NODE kinds in slot order; the cluster-scope kinds follow them with
 # node = NIL. Slot m's (node, kind) pair is a compile-time constant -- only
 # the flag and detail are data.
 PER_NODE_KINDS = (
     EV_FOLLOWER, EV_PRECANDIDATE, EV_CANDIDATE, EV_LEADER, EV_TERM, EV_VOTE,
     EV_COMMIT, EV_APPEND, EV_TRUNCATE, EV_CRASH, EV_RESTART, EV_DROP,
+    EV_XFER, EV_READ_ISSUE, EV_READ_SERVE,
 )
-CLUSTER_KINDS = (EV_VIOLATION, EV_PARTITION)
+CLUSTER_KINDS = (EV_VIOLATION, EV_PARTITION, EV_EPOCH)
 
 # Violation bitmask bits (EV_VIOLATION detail).
 VIOL_ELECTION = 1
@@ -192,6 +209,26 @@ def extract(
         (inp.restarted, z32),
         (burst, dropped),
     )
+    # Reconfiguration-plane kinds, delta-derived like everything else (the
+    # serve-vs-cancel disambiguation rides the kernels' documented clear
+    # rules: a slot dropped while its holder stays a same-term leader was
+    # SERVED; every cancel path -- role loss, term adoption, restart --
+    # changes role/term or sets `restarted`). Structurally gated configs
+    # leave these planes untouched, so the flags are constant-false there.
+    xfer_flag = (new.xfer_to != old.xfer_to) & (new.xfer_to != NIL)
+    read_issue = (new.read_idx > 0) & (new.read_idx != old.read_idx)
+    read_serve = (
+        (old.read_idx > 0)
+        & (new.read_idx == 0)
+        & (new.role == LEADER)
+        & (new.term == old.term)
+        & ~inp.restarted
+    )
+    blocks = blocks + (
+        (xfer_flag, new.xfer_to),
+        (read_issue, new.read_idx - 1),
+        (read_serve, old.read_idx - 1),
+    )
     viol_mask = (
         info.viol_election_safety * VIOL_ELECTION
         + info.viol_commit * VIOL_COMMIT
@@ -201,6 +238,10 @@ def extract(
     cluster = (
         (_bc(viol_mask != 0, like), _bc(viol_mask, like)),
         (_bc(cut_now != cut_prev, like), _bc(cut_now, like)),
+        (
+            _bc(new.cfg_epoch != old.cfg_epoch, like),
+            _bc(new.cfg_epoch, like),
+        ),
     )
     flags = jnp.concatenate([f for f, _ in blocks] + [f for f, _ in cluster])
     detail = jnp.concatenate(

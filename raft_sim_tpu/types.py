@@ -67,6 +67,11 @@ REQ_NONE = 0
 REQ_VOTE = 1  # :request-vote
 REQ_APPEND = 2  # :append-entries
 REQ_PREVOTE = 3  # pre-vote probe (carries the prospective term = sender term + 1)
+# TimeoutNow (Raft thesis 3.10; cfg.leader_transfer -- BEYOND the reference):
+# a transferring leader tells its caught-up target to start an election
+# IMMEDIATELY, bypassing both the election timer and pre-vote. The target node
+# id rides the Mailbox.xfer_tgt header; only the target acts on the broadcast.
+REQ_TIMEOUT_NOW = 4
 
 # Response mailbox record types (client.clj:8-9 keywordizes :type from the HTTP
 # body). A pre-vote response's GRANT rides the packed pv_grant bit-plane
@@ -199,6 +204,11 @@ class Mailbox(NamedTuple):
     req_base: jax.Array  # [N] int32: sender's log_base (snapshot lastIncludedIndex)
     req_base_term: jax.Array  # [N] int32: snapshot lastIncludedTerm
     req_base_chk: jax.Array  # [N] uint32: checksum of the compacted prefix
+    # Leadership-transfer header (cfg.leader_transfer only; NIL and carried
+    # untouched otherwise): the target of the sender's TimeoutNow broadcast
+    # (REQ_TIMEOUT_NOW). Per sender like every request header -- a leader
+    # fires at most one transfer per tick.
+    xfer_tgt: jax.Array  # [N(sender)] int8: TimeoutNow target node (NIL = none)
     req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E; -1 = snapshot
     resp_kind: jax.Array  # [N(receiver), N(responder)] int8 (RESP_*): response type per edge
     pv_grant: jax.Array  # [N(receiver), W] uint32: packed pre-vote grant bits (bit = responder)
@@ -284,6 +294,42 @@ class ClusterState(NamedTuple):
     # timeout. Volatile (restart resets it to "long quiet"). Maintained only
     # when cfg.pre_vote; untouched (loop-invariant) otherwise.
     heard_clock: jax.Array  # [N] int32
+    # Reconfiguration plane (cfg.reconfig; zeros and carried untouched
+    # otherwise -- raft_sim_tpu/reconfig, thesis chapter 4). Cluster-scoped
+    # ADMIN state, not per-node protocol state: the membership service is the
+    # simulator's external operator, so every node reads the same
+    # configuration instantly (the per-node config-in-log divergence of full
+    # Raft is out of scope; docs/PROTOCOL.md states the model precisely).
+    # member_old is the current voting configuration C_old as a packed
+    # bitplane row (bit j = node j votes); during a joint phase
+    # (cfg_pend > 0) member_new holds the target C_new and every quorum test
+    # -- election, pre-vote promotion, commit advancement, ReadIndex
+    # confirmation -- requires a majority of BOTH rows (dual popcount). The
+    # joint phase exits when a live member leader's commit reaches
+    # cfg_pend - 1 (everything up to the change point replicated under the
+    # dual quorum); cfg_epoch bumps on each phase transition so safety
+    # properties are attributable per configuration era.
+    member_old: jax.Array  # [W] uint32: packed C_old voting-membership bits
+    member_new: jax.Array  # [W] uint32: packed C_new (== C_old outside joint)
+    cfg_epoch: jax.Array  # scalar int32: configuration epoch counter
+    cfg_pend: jax.Array  # scalar int32: joint-exit commit bound + 1 (0 = not joint)
+    # Leadership-transfer plane (cfg.leader_transfer; NIL and carried
+    # untouched otherwise): a transferring leader's pending TimeoutNow target
+    # (thesis 3.10). Volatile leader state: cleared on role loss, term
+    # adoption, restart, or target unresponsiveness; re-fired each heartbeat
+    # while pending and caught up (a dropped TimeoutNow retries).
+    xfer_to: jax.Array  # [N] int32: pending transfer target (NIL = idle)
+    # ReadIndex plane (cfg.read_index; zeros and carried untouched otherwise
+    # -- thesis 6.4): one pending read slot per node. read_idx holds the
+    # captured commit index + 1 (0 = no pending read) -- capture is gated on
+    # the leader having committed a current-term entry; read_acks banks the
+    # packed per-peer AppendEntries responses received SINCE capture, and the
+    # read is served once they reach a (configuration-aware) majority with
+    # the slot's captured index covered by commit. Volatile leader state:
+    # wiped on restart, role loss, or term change.
+    read_idx: jax.Array  # [N] int32: pending read's captured index + 1 (0 = none)
+    read_tick: jax.Array  # [N] int32: offer stamp of the pending read
+    read_acks: jax.Array  # [N, W] uint32: packed acks banked since capture
     # Client-side state (cfg.client_redirect; NIL/0 otherwise): up to K =
     # cfg.client_pipeline commands the simulated client has in flight and the
     # node each one's next POST targets -- the array form of the reference
@@ -337,6 +383,21 @@ class StepInputs(NamedTuple):
     client_bounce: jax.Array  # [K] int32 in [0, N)
     alive: jax.Array  # [N] bool; False = node crashed this tick (silent, frozen)
     restarted: jax.Array  # [N] bool; True = node came back up this tick (volatile wipe)
+    # Reconfiguration-plane admin commands (all NIL unless their gate is on;
+    # raft_sim_tpu/reconfig). Cluster-scoped offers handled by the lowest-id
+    # live member leader, exactly like the direct client's command offer:
+    #   reconfig_cmd  toggle node v's voting membership (add if absent,
+    #                 remove if present; refused while a joint phase is
+    #                 pending or when the removal would leave < 2 voters)
+    #   transfer_cmd  ask the current leader to transfer leadership to node v
+    #   read_cmd      offer one ReadIndex read (the read-only traffic class)
+    # Python-int NIL defaults (not jnp scalars: a module-level jnp array
+    # would initialize the backend at import, before driver.select_backend)
+    # so hand-built test inputs predating the plane stay valid; make_inputs
+    # always materializes real arrays.
+    reconfig_cmd: jax.Array = NIL  # scalar int32 in [0, N); NIL = none
+    transfer_cmd: jax.Array = NIL  # scalar int32 in [0, N); NIL = none
+    read_cmd: jax.Array = NIL  # scalar int32 0/1 flag encoded as value; NIL = none
 
 
 class StepInfo(NamedTuple):
@@ -395,6 +456,13 @@ class StepInfo(NamedTuple):
     # transitively and via checksums). Measures the ring check's coverage
     # instead of assuming it. Zero unless check_log_matching ran this tick.
     lm_skipped_pairs: jax.Array  # int32: unordered pairs skipped by the check
+    # ReadIndex read-traffic metrics (zeros unless cfg.read_index): reads
+    # served this tick, their summed offer->serve latency, and the same
+    # log2-binned histogram shape the commit-latency metric uses -- so
+    # telemetry can report commit-vs-read latency side by side.
+    reads_served: jax.Array  # int32: ReadIndex reads served this tick
+    read_lat_sum: jax.Array  # int32: summed offer->serve latency of served reads
+    read_hist: jax.Array  # [LAT_HIST_BINS] int32 (zeros unless read_index)
 
 
 def empty_mailbox(cfg: RaftConfig) -> Mailbox:
@@ -415,6 +483,7 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         req_base=i(n),
         req_base_term=i(n),
         req_base_chk=jnp.zeros((n,), jnp.uint32),
+        xfer_tgt=jnp.full((n,), NIL, jnp.int8),
         req_off=jnp.zeros((n, n), jnp.int8),
         resp_kind=jnp.zeros((n, n), jnp.int8),
         pv_grant=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
@@ -455,6 +524,22 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         deadline=deadline,
         # "Quiet since before time began": pre-votes are grantable at boot.
         heard_clock=jnp.full((n,), -cfg.election_min_ticks, jnp.int32),
+        # Reconfiguration plane: every node votes at boot (C_old = all) when
+        # the plane is live; all-zero dead weight otherwise.
+        member_old=(
+            bitplane.full_row(n) if cfg.reconfig
+            else jnp.zeros((bitplane.n_words(n),), jnp.uint32)
+        ),
+        member_new=(
+            bitplane.full_row(n) if cfg.reconfig
+            else jnp.zeros((bitplane.n_words(n),), jnp.uint32)
+        ),
+        cfg_epoch=jnp.int32(0),
+        cfg_pend=jnp.int32(0),
+        xfer_to=jnp.full((n,), NIL, jnp.int32),  # NIL = idle, gate on or off
+        read_idx=jnp.zeros((n,), jnp.int32),
+        read_tick=jnp.zeros((n,), jnp.int32),
+        read_acks=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
         client_pend=jnp.full((cfg.client_pipeline,), NIL, jnp.int32),
         client_dst=jnp.zeros((cfg.client_pipeline,), jnp.int32),
         client_tick=jnp.zeros((cfg.client_pipeline,), jnp.int32),

@@ -80,7 +80,16 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      replication carries the stamps). All three are zeros and loop-invariant
 #      unless cfg.track_offer_ticks (client_interval > 0 or the new
 #      RaftConfig.serve_ingest gate).
-_FORMAT_VERSION = 21
+# v22: reconfiguration plane (raft_sim_tpu/reconfig) -- ClusterState gained
+#      the joint-consensus membership plane (member_old/member_new packed
+#      voting bitmaps, cfg_epoch, cfg_pend), the TimeoutNow transfer target
+#      (xfer_to), and the ReadIndex read slot (read_idx/read_tick/read_acks);
+#      Mailbox gained xfer_tgt (the TimeoutNow broadcast header). RunMetrics
+#      gained the read traffic counters (reads_served/read_lat_sum/read_hist,
+#      telemetry schema v3). All new leaves are zeros/NIL and loop-invariant
+#      unless their structural gate (reconfig_interval / transfer_interval /
+#      read_interval > 0) is on.
+_FORMAT_VERSION = 22
 
 # The single exported source of truth for the on-disk format version
 # (re-exported as raft_sim_tpu.CHECKPOINT_FORMAT_VERSION). Everything that
@@ -96,7 +105,7 @@ FORMAT_VERSION = _FORMAT_VERSION
 # refreshing this pin -- the convention the v2..v19 log always relied on,
 # now machine-checked. Refresh with:
 #     python -c "from raft_sim_tpu.analysis import policy; print(policy.schema_fingerprint())"
-_SCHEMA_FINGERPRINT = (21, "350d7326be89d46b")
+_SCHEMA_FINGERPRINT = (22, "fb55c045173c093d")
 
 
 def _normalize(path: str) -> str:

@@ -156,6 +156,34 @@ class RaftConfig:
     # either way (tests/test_trace.py pins instrumented == plain).
     track_trace: bool = False
 
+    # Reconfiguration plane (raft_sim_tpu/reconfig; thesis chapter 4 /
+    # 3.10 / 6.4 -- all three BEYOND the reference). Each extension follows
+    # the client_interval pattern: the nonzero cadence is the STRUCTURAL gate
+    # (it decides which carry legs the tick maintains and which quorum form
+    # compiles), while the cadence VALUE itself is tunable -- the scenario
+    # genome can retime commands without forking a compile.
+    #
+    # Joint-consensus membership change (thesis 4.3): every
+    # `reconfig_interval` ticks the admin offers a membership toggle of a
+    # rotating node to the leader; the cluster transitions through a joint
+    # phase in which every quorum test needs a majority of BOTH the old and
+    # new configurations (ClusterState.member_old/member_new docstring).
+    reconfig_interval: int = 0
+    # TimeoutNow leadership transfer (thesis 3.10): every `transfer_interval`
+    # ticks the admin asks the current leader to transfer leadership to a
+    # rotating target. The leader stops accepting client commands while the
+    # transfer is pending (the lease handoff), waits for the target to match
+    # its log, then fires REQ_TIMEOUT_NOW; the target starts a REAL election
+    # immediately, bypassing its timer AND pre-vote.
+    transfer_interval: int = 0
+    # ReadIndex linearizable reads (thesis 6.4): every `read_interval` ticks
+    # one read-only request is offered. The leader captures its commit index
+    # (only once it has committed a current-term entry), confirms leadership
+    # with a round of AppendEntries responses from a quorum, then serves --
+    # a read traffic class with its own latency histogram
+    # (StepInfo.read_hist) beside the write path's commit latency.
+    read_interval: int = 0
+
     # PreVote (Raft thesis 9.6; BEYOND the reference, which has neither
     # pre-vote nor leadership transfer -- SURVEY.md 2.3.12). When True, an
     # expired node becomes a PRECANDIDATE and probes a majority at its
@@ -207,6 +235,14 @@ class RaftConfig:
         # margin >= 2 keeps that client ceiling above the steady-state retained
         # window (CAP - margin), and the margin must not consume the whole ring.
         assert self.compact_margin == 0 or 2 <= self.compact_margin < self.log_capacity
+        # Reconfiguration-plane cadences are non-negative; membership change
+        # needs at least 3 nodes so a removal can never strand a 1-voter
+        # configuration mid-experiment (the kernel additionally refuses any
+        # toggle that would leave < 2 voters).
+        assert self.reconfig_interval >= 0
+        assert self.transfer_interval >= 0
+        assert self.read_interval >= 0
+        assert self.reconfig_interval == 0 or self.n_nodes >= 3
 
     @property
     def track_offer_ticks(self) -> bool:
@@ -222,6 +258,50 @@ class RaftConfig:
     def compaction(self) -> bool:
         """True when the ring-log compaction path is active (compact_margin > 0)."""
         return self.compact_margin > 0
+
+    @property
+    def reconfig(self) -> bool:
+        """True when the joint-consensus membership plane is active: the
+        member bitplanes are maintained and every quorum test is
+        configuration-masked (dual popcount during joint phases)."""
+        return self.reconfig_interval > 0
+
+    @property
+    def leader_transfer(self) -> bool:
+        """True when the TimeoutNow transfer plane is active (xfer_to state,
+        the xfer_tgt wire header, and the REQ_TIMEOUT_NOW handler compile)."""
+        return self.transfer_interval > 0
+
+    @property
+    def read_index(self) -> bool:
+        """True when the ReadIndex read traffic class is active (read slot
+        state, ack banking, and the read latency histogram compile)."""
+        return self.read_interval > 0
+
+    # -- TEST-ONLY mutation hooks (scenario/mutation.py). Each extension's
+    # correctness hinges on one rule; these properties are that rule as data,
+    # so a mutant config subclass can weaken exactly it and the CE hunt must
+    # re-find the injected bug. Production configs always return True.
+    @property
+    def joint_consensus(self) -> bool:
+        """False (mutants only): membership toggles apply IMMEDIATELY with no
+        joint phase -- the classic one-step membership change whose old/new
+        quorums need not intersect (thesis 4.3's motivating bug)."""
+        return True
+
+    @property
+    def read_confirm(self) -> bool:
+        """False (mutants only): ReadIndex serves at capture time with no
+        leadership confirmation round and no current-term-commit capture
+        gate -- the stale-read-below-the-committed-frontier bug."""
+        return True
+
+    @property
+    def xfer_election(self) -> bool:
+        """False (mutants only): a TimeoutNow target assumes leadership
+        DIRECTLY (no vote round, no up-to-date check) and the leader fires
+        without waiting for the target to catch up -- transfer as a coup."""
+        return True
 
     @property
     def ack_age_sat(self) -> int:
@@ -322,6 +402,33 @@ PRESETS: dict[str, tuple[RaftConfig, int]] = {
             crash_down_ticks=12,
             client_redirect=True,
             client_pipeline=5,
+        ),
+        1_000,
+    ),
+    # config3 with PreVote (thesis 9.6): the standing bench row that prices
+    # pre_vote's cost against the config3 baseline -- the number used to live
+    # in docs/PERF.md prose, now measured every bench run (ROADMAP item 5).
+    "config3p": (RaftConfig(n_nodes=5, pre_vote=True), 100_000),
+    # Reconfiguration-plane acceptance preset (raft_sim_tpu/reconfig): the
+    # three thesis extensions -- joint-consensus membership change,
+    # TimeoutNow leadership transfer, ReadIndex reads -- all live at once,
+    # under client traffic + drop + crash churn. The add/remove-under-fire
+    # tier: membership toggles land every ~97 ticks while elections, crashes,
+    # and transfers are in flight; the trace checker must pass all properties
+    # over its histories (tests/test_reconfig.py, CI reconfig smoke).
+    "config8": (
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=64,
+            max_entries_per_rpc=4,
+            client_interval=4,
+            drop_prob=0.1,
+            crash_prob=0.25,
+            crash_period=64,
+            crash_down_ticks=12,
+            reconfig_interval=97,
+            transfer_interval=61,
+            read_interval=7,
         ),
         1_000,
     ),

@@ -68,7 +68,10 @@ from raft_sim_tpu.utils.config import RaftConfig
 # refuses mismatched directories and metrics_report refuses to diff them.
 # v2: window lines gained multi_leader (split-brain exposure ticks --
 #     RunMetrics metrics v4, the scenario search's election-safety precursor).
-TELEMETRY_SCHEMA_VERSION = 2
+#  3: windows.jsonl gained the ReadIndex read-traffic columns (reads,
+#     read_lat_sum, read_hist -- the read-side mirror of the commit-latency
+#     fields; zeros unless cfg.read_index).
+TELEMETRY_SCHEMA_VERSION = 3
 
 # A "never happened" tick sentinel (scan.NEVER) becomes JSON null.
 _NEVER = 2**31 - 1
@@ -91,6 +94,8 @@ WINDOW_FIELDS = (
     "noop_blocked",
     "lm_skipped_pairs",
     "multi_leader",
+    "reads",
+    "read_lat_sum",
 )
 
 # Per-line required fields of perf.jsonl (obs/timer.py ChunkTimer rows).
@@ -214,8 +219,15 @@ class TelemetrySink:
                 "multi_leader": int(
                     m["multi_leader"].astype(np.int64)[:, w].sum()
                 ),
+                "reads": int(m["reads_served"].astype(np.int64)[:, w].sum()),
+                "read_lat_sum": int(
+                    m["read_lat_sum"].astype(np.int64)[:, w].sum()
+                ),
                 "lat_hist": [
                     int(x) for x in m["lat_hist"].astype(np.int64)[:, w].sum(axis=0)
+                ],
+                "read_hist": [
+                    int(x) for x in m["read_hist"].astype(np.int64)[:, w].sum(axis=0)
                 ],
             })
         with open(self._path("windows.jsonl"), "a") as f:
@@ -380,15 +392,17 @@ def validate(directory: str) -> list[str]:
             fv = row.get("first_viol_tick")
             if fv is not None and not isinstance(fv, int):
                 errors.append(f"windows.jsonl:{ln}: first_viol_tick must be int or null")
-            hist = row.get("lat_hist")
-            if (
-                not isinstance(hist, list)
-                or len(hist) != LAT_HIST_BINS
-                or not all(isinstance(x, int) and x >= 0 for x in hist)
-            ):
-                errors.append(
-                    f"windows.jsonl:{ln}: lat_hist must be {LAT_HIST_BINS} non-negative ints"
-                )
+            for hk in ("lat_hist", "read_hist"):
+                hist = row.get(hk)
+                if (
+                    not isinstance(hist, list)
+                    or len(hist) != LAT_HIST_BINS
+                    or not all(isinstance(x, int) and x >= 0 for x in hist)
+                ):
+                    errors.append(
+                        f"windows.jsonl:{ln}: {hk} must be {LAT_HIST_BINS} "
+                        "non-negative ints"
+                    )
             if isinstance(row.get("window"), int):
                 if row["window"] != prev_idx + 1:
                     errors.append(

@@ -21,6 +21,7 @@ import numpy as np
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 PRECANDIDATE = 3  # cfg.pre_vote probe state (thesis 9.6)
 REQ_NONE, REQ_VOTE, REQ_APPEND, REQ_PREVOTE = 0, 1, 2, 3
+REQ_TIMEOUT_NOW = 4  # cfg.leader_transfer (thesis 3.10)
 RESP_NONE, RESP_VOTE, RESP_APPEND, RESP_PREVOTE = 0, 1, 2, 3
 NIL = -1
 # Independently-stated copies of the implementation's constants (the oracle must not
@@ -86,6 +87,11 @@ def state_to_dict(state) -> dict:
     n = d["role"].shape[0]
     d["votes"] = unpack_plane(d["votes"], n)
     d["mailbox"]["pv_grant"] = unpack_plane(d["mailbox"]["pv_grant"], n)
+    # Reconfiguration / ReadIndex packed planes: the oracle's view (and the
+    # parity tests' comparison domain) is the dense boolean one.
+    d["member_old"] = unpack_plane(d["member_old"], n)
+    d["member_new"] = unpack_plane(d["member_new"], n)
+    d["read_acks"] = unpack_plane(d["read_acks"], n)
     return d
 
 
@@ -118,6 +124,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     track = cfg.track_offer_ticks
     mb = s["mailbox"]
 
+    rcf = cfg.reconfig
+    xfr = cfg.leader_transfer
+    rdx = cfg.read_index
     role = s["role"].copy()
     term = s["term"].copy()
     voted_for = s["voted_for"].copy()
@@ -137,6 +146,14 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     log_len = s["log_len"].copy()
     deadline = s["deadline"].copy()
     heard_clock = s["heard_clock"].copy()
+    member_old = s["member_old"].copy()  # [N] bool (oracle view: unpacked)
+    member_new = s["member_new"].copy()
+    cfg_epoch = int(s["cfg_epoch"])
+    cfg_pend = int(s["cfg_pend"])
+    xfer_to = np.asarray(s["xfer_to"], np.int32).copy()
+    read_idx = s["read_idx"].copy()
+    read_tick = s["read_tick"].copy()
+    read_acks = np.asarray(s["read_acks"], bool).copy()
 
     alive = np.asarray(inp["alive"], bool)
     restarted = np.asarray(inp["restarted"], bool)
@@ -157,6 +174,35 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             if cfg.pre_vote:
                 # a restarted node remembers no leader contact
                 heard_clock[d] = int(s["clock"][d]) - cfg.election_min_ticks
+            if xfr:
+                xfer_to[d] = NIL  # pending transfers die with the process
+            if rdx:
+                read_idx[d] = 0  # pending reads die with the process
+                read_tick[d] = 0
+                read_acks[d, :] = False
+
+    # Reconfiguration plane: the TICK-START configuration governs every
+    # quorum test this tick (models/raft.py); phase 5.2 transitions apply
+    # afterward. The quorum helper closes over SNAPSHOTS -- the 5.2 block
+    # rebinds member_old/member_new in place, and a late-bound closure would
+    # judge the ReadIndex confirmation (which runs after 5.2) under the
+    # post-transition masks while the kernel pins the tick-start ones.
+    joint0 = cfg_pend > 0
+    if rcf:
+        q_member_old = member_old.copy()  # tick-start masks, never rebound
+        q_member_new = member_new.copy()
+        maj_old = int(q_member_old.sum()) // 2 + 1
+        maj_new = int(q_member_new.sum()) // 2 + 1
+        member_b = q_member_old | q_member_new
+
+    def packed_quorum_row(grants_row: np.ndarray) -> bool:
+        """grants_row: [N] bool of banked grants -> config-masked quorum."""
+        if not rcf:
+            return int(grants_row.sum()) >= cfg.quorum
+        ok = int((grants_row & q_member_old).sum()) >= maj_old
+        if joint0:
+            ok = ok and int((grants_row & q_member_new).sum()) >= maj_new
+        return ok
 
     # ---- phase 0: delivery
     # Input mask is per physical edge [to, from]; request headers are per sender
@@ -361,6 +407,33 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 if quiet and up and int(mb["req_term"][src]) >= int(term[d]):
                     pv_grant[d, src] = True
 
+    # ---- phase 3.7: TimeoutNow receipt (thesis 3.10; models/raft.py)
+    xfer_elect = np.zeros(n, bool)
+    coup = np.zeros(n, bool)
+    if xfr:
+        for d in range(n):
+            if role[d] == LEADER or not alive[d]:
+                continue
+            if rcf and not member_b[d]:
+                continue  # non-voters never campaign
+            got = any(
+                req_in[src, d]
+                and mb["req_type"][src] == REQ_TIMEOUT_NOW
+                and int(mb["xfer_tgt"][src]) == d
+                and int(mb["req_term"][src]) == int(term[d])
+                for src in range(n)
+            )
+            if not got:
+                continue
+            if cfg.xfer_election:
+                xfer_elect[d] = True
+            else:
+                # TEST-ONLY mutant: transfer as a coup (no vote round).
+                coup[d] = True
+                term[d] += 1
+                role[d] = LEADER
+                leader_id[d] = d
+
     # ---- phase 4: responses
     # Everyone's ack age grows one tick (saturating); stamps below zero it.
     ack_age = np.minimum(ack_age + 1, ack_age_sat(cfg)).astype(ack_age.dtype)
@@ -376,7 +449,10 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 votes[d, src] = True
     win = np.zeros(n, bool)
     for d in range(n):
-        if role[d] == CANDIDATE and int(votes[d].sum()) >= cfg.quorum and alive[d]:
+        campaign_ok = role[d] == CANDIDATE and packed_quorum_row(votes[d]) and alive[d]
+        if rcf and not member_b[d]:
+            campaign_ok = False  # removed nodes cannot win on banked votes
+        if campaign_ok or coup[d]:
             win[d] = True
             role[d] = LEADER
             leader_id[d] = d
@@ -399,13 +475,18 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                     and bool(mb["pv_grant"][d, src])
                 ):
                     votes[d, src] = True
-            if int(votes[d].sum()) >= cfg.quorum and alive[d]:
+            if (
+                packed_quorum_row(votes[d])
+                and alive[d]
+                and not (rcf and not member_b[d])
+            ):
                 pre_win[d] = True
                 term[d] += 1
                 role[d] = CANDIDATE
                 voted_for[d] = d
                 votes[d, :] = False
                 votes[d, d] = True
+    aresp_pairs = np.zeros((n, n), bool)  # [leader, responder]: AE response seen
     for d in range(n):
         if role[d] != LEADER:
             continue
@@ -416,6 +497,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 and mb["resp_term"][src] == term[d]
             ):
                 continue
+            aresp_pairs[d, src] = True
             if mb["a_ok_to"][src] == d:
                 m = int(mb["a_match"][src])
                 match_index[d, src] = max(int(match_index[d, src]), m)
@@ -430,16 +512,135 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             ack_age[d, src] = 0
 
     # ---- phase 5: leader commit advancement
+    def masked_qmatch(match: np.ndarray, mask: np.ndarray, maj: int) -> int:
+        """Largest index replicated to >= maj members of `mask` (0 if none);
+        candidates range over the members' own match values (raft.py)."""
+        best = 0
+        for j in range(n):
+            if not mask[j]:
+                continue
+            v = int(match[j])
+            if sum(1 for k in range(n) if mask[k] and int(match[k]) >= v) >= maj:
+                best = max(best, v)
+        return best
+
     for d in range(n):
         if role[d] != LEADER or not alive[d]:
             continue
         match = match_index[d].copy()
         match[d] = log_len[d]
-        quorum_match = int(np.sort(match)[::-1][cfg.quorum - 1])
+        if rcf:
+            quorum_match = masked_qmatch(match, member_old, maj_old)
+            if joint0:
+                quorum_match = min(
+                    quorum_match, masked_qmatch(match, member_new, maj_new)
+                )
+        else:
+            quorum_match = int(np.sort(match)[::-1][cfg.quorum - 1])
         if quorum_match > commit[d] and term_at_ring(
             log_term[d], int(log_base[d]), int(base_term[d]), quorum_match
         ) == term[d]:
             commit[d] = quorum_match
+
+    # ---- phase 5.2: reconfiguration admin (models/raft.py phase 5.2)
+    member_b2 = member_old | member_new if rcf else None
+    xfer_pend = np.zeros(n, bool)
+    if rcf:
+        # Joint exit: a live member leader's commit covers the change point.
+        exit_j = joint0 and any(
+            role[d] == LEADER and alive[d] and member_b[d]
+            and int(commit[d]) >= cfg_pend - 1
+            for d in range(n)
+        )
+        if exit_j:
+            member_old = member_new.copy()
+            cfg_pend = 0
+            cfg_epoch += 1
+        joint2 = cfg_pend > 0
+        # Accept a membership toggle at the lowest-id live member leader.
+        memb_mid = member_old | member_new
+        lds = [
+            d for d in range(n) if role[d] == LEADER and alive[d] and memb_mid[d]
+        ]
+        t_r = int(inp["reconfig_cmd"])
+        if t_r != NIL and not joint2 and lds and 0 <= t_r < n:
+            toggled = member_new.copy()
+            toggled[t_r] = not toggled[t_r]
+            if int(toggled.sum()) >= 2:
+                ld = min(lds)
+                if cfg.joint_consensus:
+                    member_new = toggled
+                    cfg_pend = int(log_len[ld]) + 1
+                else:
+                    # TEST-ONLY mutant: one-step membership change.
+                    member_old = toggled.copy()
+                    member_new = toggled
+                cfg_epoch += 1
+        # Removed-leader stepdown (non-voting catch-up: learner from now on).
+        member_b2 = member_old | member_new
+        for d in range(n):
+            if not member_b2[d] and role[d] != FOLLOWER:
+                role[d] = FOLLOWER
+                leader_id[d] = NIL
+    if xfr:
+        for d in range(n):
+            if xfer_to[d] != NIL:
+                t = int(xfer_to[d])
+                if (
+                    role[d] != LEADER
+                    or int(ack_age[d, t]) > cfg.ack_timeout_ticks
+                ):
+                    xfer_to[d] = NIL  # abort: deposed or unresponsive target
+        t_x = int(inp["transfer_cmd"])
+        ld_ok = [
+            d
+            for d in range(n)
+            if role[d] == LEADER and alive[d] and not (rcf and not member_b2[d])
+        ]
+        if t_x != NIL and ld_ok:
+            ldx = min(ld_ok)
+            t_voter = member_new[t_x] if rcf else True
+            if t_x != ldx and t_voter and xfer_to[ldx] == NIL:
+                xfer_to[ldx] = t_x
+        xfer_pend = xfer_to != NIL
+    if rdx:
+        # Bank this tick's AE responses, serve confirmed reads, capture new.
+        pend0_arr = read_idx > 0  # pending at tick start (pre-serve/capture)
+        for d in range(n):
+            pend0 = bool(pend0_arr[d])
+            if pend0 and role[d] == LEADER:
+                read_acks[d] |= aresp_pairs[d]
+                acks_eff = read_acks[d].copy()
+                acks_eff[d] = True
+                confirmed = packed_quorum_row(acks_eff)
+                if (confirmed if cfg.read_confirm else True) and alive[d]:
+                    # serve (the latency metric rides StepInfo, which the
+                    # oracle does not produce; parity pins the slot clears)
+                    read_idx[d] = 0
+                    read_tick[d] = 0
+                    read_acks[d, :] = False
+            elif pend0:
+                read_idx[d] = 0  # role loss / adoption cancels the read
+                read_tick[d] = 0
+                read_acks[d, :] = False
+        if int(inp["read_cmd"]) != NIL:
+            caps = []
+            for d in range(n):
+                if not (role[d] == LEADER and alive[d] and not pend0_arr[d]):
+                    continue
+                if xfr and xfer_pend[d]:
+                    continue
+                if cfg.read_confirm and term_at_ring(
+                    log_term[d], int(log_base[d]), int(base_term[d]),
+                    int(commit[d]),
+                ) != int(term[d]):
+                    continue  # no current-term entry committed yet
+                caps.append(d)
+            if caps:
+                d = min(caps)
+                read_idx[d] = int(commit[d]) + 1
+                read_tick[d] = int(s["now"]) + 1
+                read_acks[d, :] = False
 
     # ---- phase 5.5: log compaction (advance base toward commit when fewer than
     # compact_margin free ring slots remain; base_chk extends in the checksum pass)
@@ -520,7 +721,10 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 append(d, NOOP, 0)
                 continue
             here = [k for k in range(K) if pend[k] != NIL and tgt[k] == d]
-            if here and role[d] == LEADER and alive[d] and room_at(d):
+            if (
+                here and role[d] == LEADER and alive[d] and room_at(d)
+                and not (xfr and xfer_pend[d])  # transfer lease handoff
+            ):
                 k = min(here)
                 append(d, pend[k], ptk[k])
                 accepted[k] = True
@@ -542,7 +746,10 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for d in range(n):
             if noop_at(d):
                 append(d, NOOP, 0)
-            elif cmd_in != NIL and role[d] == LEADER and alive[d] and room_at(d):
+            elif (
+                cmd_in != NIL and role[d] == LEADER and alive[d] and room_at(d)
+                and not (xfr and xfer_pend[d])  # transfer lease handoff
+            ):
                 append(d, cmd_in, now0 + 1)
 
     # ---- phase 7: timers
@@ -561,7 +768,10 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         if expired and role[d] == LEADER:
             heartbeat[d] = True
             deadline[d] = clock[d] + cfg.heartbeat_ticks
-        elif expired and cfg.pre_vote:
+        elif expired and cfg.pre_vote and (
+            not (rcf and not member_b2[d])  # non-voters never campaign
+            and not (xfr and xfer_elect[d])  # thesis-3.10 pre-vote bypass
+        ):
             # expiry starts a PRE-vote probe: no term bump, votedFor untouched
             start_prevote[d] = True
             role[d] = PRECANDIDATE
@@ -569,7 +779,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, :] = False
             votes[d, d] = True
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
-        elif expired:
+        elif expired and not cfg.pre_vote and not (rcf and not member_b2[d]):
             start_election[d] = True
             term[d] += 1
             role[d] = CANDIDATE
@@ -580,7 +790,20 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
     if cfg.pre_vote:
         # real RequestVote broadcasts come from this tick's promotions
-        start_election = pre_win
+        start_election = pre_win.copy()
+    if xfr:
+        # TimeoutNow elections: the real-election start, bypassing timer and
+        # pre-vote (~LEADER re-checked: a phase-4 win may have promoted).
+        for d in range(n):
+            if xfer_elect[d] and role[d] != LEADER and not start_election[d]:
+                start_election[d] = True
+                term[d] += 1
+                role[d] = CANDIDATE
+                voted_for[d] = d
+                leader_id[d] = NIL
+                votes[d, :] = False
+                votes[d, d] = True
+                deadline[d] = clock[d] + int(inp["timeout_draw"][d])
 
     # ---- phase 8: outbox (wire format v8: per-sender headers + per-edge offsets)
     z = lambda *shape: np.zeros(shape, np.int32)
@@ -599,6 +822,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "req_base": z(n),
         "req_base_term": z(n),
         "req_base_chk": np.zeros(n, np.uint32),
+        "xfer_tgt": np.full(n, NIL, np.int32),
         "req_off": z(n, n),
         "resp_kind": z(n, n),
         "pv_grant": np.zeros((n, n), bool),
@@ -668,6 +892,18 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                     out["req_off"][src, dst] = -1
                 else:
                     out["req_off"][src, dst] = min(max(p, ws), ws + e) - ws
+            if xfr and xfer_to[src] != NIL:
+                # TimeoutNow fire (raft.py phase 8): replaces the heartbeat
+                # slot once the target matched the leader's log; the AE
+                # window fields above stay populated (receivers gate on
+                # req_type == REQ_APPEND).
+                t = int(xfer_to[src])
+                caught = (not cfg.xfer_election) or int(
+                    match_index[src, t]
+                ) >= int(log_len[src])
+                if caught:
+                    out["req_type"][src] = REQ_TIMEOUT_NOW
+                    out["xfer_tgt"][src] = t
     # Responses travel back src<->dst: responder r answers requester q; the edge
     # plane carries only the type, payloads ride the per-responder fields above.
     for r in range(n):
@@ -711,6 +947,14 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "clock": clock,
         "deadline": deadline,
         "heard_clock": heard_clock,
+        "member_old": member_old,
+        "member_new": member_new,
+        "cfg_epoch": np.int32(cfg_epoch),
+        "cfg_pend": np.int32(cfg_pend),
+        "xfer_to": xfer_to,
+        "read_idx": read_idx,
+        "read_tick": read_tick,
+        "read_acks": read_acks,
         "client_pend": np.asarray(client_pend, np.int32),
         "client_dst": np.asarray(client_dst, np.int32),
         "client_tick": np.asarray(client_tick, np.int32),

@@ -162,10 +162,13 @@ def test_types_comments_parse_and_hold():
     specs, problems = policy.parse_types_comments()
     assert problems == []
     # Full field coverage: every field of the four structures has a contract.
-    assert len(specs["ClusterState"]) == 25  # v21: +log_tick, +client_tick
-    assert len(specs["Mailbox"]) == 22  # v21: +ent_tick
-    assert len(specs["StepInputs"]) == 8
-    assert len(specs["StepInfo"]) == 16
+    # v22: +member_old/member_new/cfg_epoch/cfg_pend (joint-consensus
+    # membership plane), +xfer_to (TimeoutNow), +read_idx/read_tick/read_acks
+    # (ReadIndex slot)
+    assert len(specs["ClusterState"]) == 33
+    assert len(specs["Mailbox"]) == 23  # v22: +xfer_tgt
+    assert len(specs["StepInputs"]) == 11  # v22: +reconfig/transfer/read cmds
+    assert len(specs["StepInfo"]) == 19  # v22: +reads_served/read_lat_sum/read_hist
     assert ast_lint.check_dtype_comments() == []
 
 

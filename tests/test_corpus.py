@@ -1,0 +1,54 @@
+"""Regression corpus: every bug the violation hunt ever found stays found.
+
+tests/corpus/ holds shrunk `scenario-repro-v1` artifacts (scenario/shrink.py)
+-- one per historical hunt hit, named `<mutant>-<topology>.json`. Each must
+replay BIT-EXACTLY (identical violation tick AND kinds) via tools/repro.py,
+the same replayer CI's scenario smoke uses: a drifting replay means the
+(genome, seed, kernel) bookkeeping broke, and a clean replay of a mutant
+artifact on a FIXED kernel would mean the regression resurfaced the bug's
+preconditions without its effect -- either way the corpus is the tripwire.
+
+Artifacts are deliberately SMALL (N=5, short horizons): replaying the corpus
+costs one tiny scan compile per artifact, so it can grow by dozens before
+threatening the tier-1 budget. Seed additions: the weak-quorum election-
+safety hit and the blind-transfer commit-invariant hit (the PR-10
+reconfiguration plane's coup mutant), both hunted, shrunk, and frozen here.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+CORPUS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "corpus")
+ARTIFACTS = sorted(glob.glob(os.path.join(CORPUS_DIR, "*.json")))
+
+
+def test_corpus_is_seeded():
+    """The corpus exists and carries at least the two seed artifacts."""
+    names = {os.path.basename(p) for p in ARTIFACTS}
+    assert "weak-quorum-n5.json" in names
+    assert "blind-transfer-n5.json" in names
+
+
+@pytest.mark.parametrize(
+    "artifact", ARTIFACTS, ids=[os.path.basename(p) for p in ARTIFACTS]
+)
+def test_corpus_artifact_replays_bit_exactly(artifact):
+    repo = os.path.dirname(CORPUS_DIR.rstrip(os.sep)).rsplit(os.sep, 1)[0]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "repro.py"),
+         "--scenario", artifact],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (
+        f"{os.path.basename(artifact)} did not replay bit-exactly "
+        f"(exit {proc.returncode}):\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )

@@ -58,23 +58,27 @@ SNAPSHOT_PROGRAMS = (
 # genome input path; step kernels are untouched, so zero extra step
 # lowerings) and NEVER one per genome or segment -- genome values are traced
 # data, pinned by the analyzer's scenario fork check (jaxpr_audit).
-PINNED_STEP_LOWERINGS = 8
-PINNED_SCAN_LOWERINGS = 8
-PINNED_SCENARIO_SCAN_LOWERINGS = 8
+# 10 = the 8 pre-v22 presets' programs + config3p (the PreVote bench row:
+# pre_vote is a structural gate, so its program is a deliberate fork) +
+# config8 (the reconfiguration plane: membership/transfer/read legs live).
+PINNED_STEP_LOWERINGS = 10
+PINNED_SCAN_LOWERINGS = 10
+PINNED_SCENARIO_SCAN_LOWERINGS = 10
 # The standing-fleet serve program (serve/loop.py simulate_serve): one program
 # per structurally distinct serve-mode config. Serve variants collapse the
 # scheduled cadence (client_interval -> 0), so presets differing ONLY in their
 # cadence share one serve program (config2's serve variant IS config3's) --
 # which is why this pin sits below the preset count. Command values are traced
 # data: a multi-chunk `driver serve` session compiles nothing after warmup.
-PINNED_SERVE_SCAN_LOWERINGS = 7
+# (+ config3p / config8 serve variants: 7 -> 9.)
+PINNED_SERVE_SCAN_LOWERINGS = 9
 # The protocol-trace program (telemetry windowed scan + event ring + coverage
 # legs, raft_sim_tpu/trace): at most one per preset -- these are "the pinned
 # trace variants" ISSUE 9's acceptance names: tracing adds ZERO step lowerings
 # (extraction is delta-based outside the kernels) and the coverage search's
 # generations all reuse one trace program (genomes are traced data; the
 # analyzer's trace fork pairs pin value-invariance).
-PINNED_TRACE_SCAN_LOWERINGS = 8
+PINNED_TRACE_SCAN_LOWERINGS = 10  # + config3p/config8 trace variants
 
 
 def _pins():

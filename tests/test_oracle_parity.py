@@ -187,6 +187,45 @@ CONFIGS = [
         # last-entry position wraps, election no-ops burning ring reserve while
         # probes defer the term bump, snapshot catch-up of crashed probers
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=2,
+            reconfig_interval=11,
+            transfer_interval=13,
+            read_interval=3,
+            drop_prob=0.2,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        13,
+        id="n5-reconfig-plane",  # all three thesis extensions at once, under
+        # drop + crash churn: joint entry/exit + dual quorums + removed-leader
+        # stepdown, transfer lease/fire/receipt elections, read capture/
+        # confirm/serve -- the full raft_sim_tpu/reconfig surface vs the oracle
+    ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            client_interval=1,
+            reconfig_interval=11,
+            transfer_interval=13,
+            read_interval=3,
+            pre_vote=True,
+            drop_prob=0.2,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        14,
+        id="n5-reconfig-prevote-compaction",  # the reconfiguration plane
+        # crossed with BOTH other structural gates: TimeoutNow's pre-vote
+        # bypass, masked pre-quorums, ring-log current-term read captures
+    ),
 ]
 
 

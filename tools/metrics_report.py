@@ -38,17 +38,26 @@ def _merge_windows(rows: list[dict]) -> dict:
     if not rows:
         return {}
     hist = np.zeros(LAT_HIST_BINS, np.int64)
+    rhist = np.zeros(LAT_HIST_BINS, np.int64)
     tot = {k: 0 for k in ("violations", "msgs", "cmds", "lat_sum", "lat_cnt",
                           "lat_excluded", "noop_blocked", "lm_skipped_pairs",
-                          "multi_leader", "ticks")}
+                          "multi_leader", "reads", "read_lat_sum", "ticks")}
     first_viol = None
     mx = {"max_term": 0, "max_commit": 0}
     for r in rows:
         for k in tot:
-            tot[k] += r[k]
+            if k in ("reads", "read_lat_sum"):
+                # Only the v3 read-class keys may be absent (pre-v3 lines,
+                # BENCH_r* rows); a missing CORE key is corruption and must
+                # keep raising, not merge as zero.
+                tot[k] += r.get(k, 0)
+            else:
+                tot[k] += r[k]
         for k in mx:
             mx[k] = max(mx[k], r[k])
         hist += np.asarray(r["lat_hist"], np.int64)
+        # Pre-v3 window lines carry no read traffic class: treat as zero.
+        rhist += np.asarray(r.get("read_hist", [0] * LAT_HIST_BINS), np.int64)
         if first_viol is None and r.get("first_viol_tick") is not None:
             first_viol = r["first_viol_tick"]
     out = tot | mx
@@ -58,6 +67,11 @@ def _merge_windows(rows: list[dict]) -> dict:
     out["lat_p99"] = _hist_percentile(hist, 0.99)
     out["mean_commit_latency"] = (
         round(tot["lat_sum"] / tot["lat_cnt"], 3) if tot["lat_cnt"] else None
+    )
+    out["read_p50"] = _hist_percentile(rhist, 0.50)
+    out["read_p99"] = _hist_percentile(rhist, 0.99)
+    out["mean_read_latency"] = (
+        round(tot["read_lat_sum"] / tot["reads"], 3) if tot["reads"] else None
     )
     return out
 
@@ -147,7 +161,8 @@ def report(directory: str, n_windows: int, out=None) -> None:
     print(f"\n  {len(rows)} windows, {totals['ticks']} ticks per cluster", file=out)
     keys = ("violations", "first_viol_tick", "msgs", "cmds", "max_commit",
             "mean_commit_latency", "lat_p50", "lat_p95", "lat_p99",
-            "lat_excluded", "noop_blocked", "lm_skipped_pairs", "multi_leader")
+            "lat_excluded", "noop_blocked", "lm_skipped_pairs", "multi_leader",
+            "reads", "mean_read_latency", "read_p50", "read_p99")
     for k in keys:
         print(f"  {k:22} {_fmt(totals.get(k)):>14}", file=out)
 
