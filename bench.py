@@ -55,6 +55,7 @@ NORTH_STAR = 1_000_000.0  # cluster-ticks/sec/chip, BASELINE.json north_star
 # (not only on reliable nets).
 MATRIX_TICKS = {
     "config1": 10_000,
+    "config9": 500,
     "config2": 2_000,
     "config3": 500,
     "config3p": 500,
@@ -66,6 +67,7 @@ MATRIX_TICKS = {
 }
 SMOKE_BATCH = {
     "config2": 64,
+    "config9": 64,
     "config3": 512,
     "config3p": 512,
     "config4": 256,
@@ -259,6 +261,84 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 3,
     if roof and scenario is None:
         row["predicted_roofline_ticks_per_s"] = round(roof, 1)
         row["roofline_headroom"] = round(roof / value, 3)
+    return row
+
+
+def serve_bench(preset: str = "config9", batch: int | None = None,
+                chunks: int = 8, chunk: int = 256, window: int = 64,
+                tenants_n: int = 4, smoke: bool = False) -> dict:
+    """The standing serve-throughput row: a multi-tenant ServeSession under
+    saturating synthetic load, measured in COMMANDS+READS per second -- the
+    service's unit of work -- never ticks/s (ROADMAP item 2's done-bar).
+
+    Load model: `tenants_n` tenants partition the fleet; every tenant's
+    source offers one distinct command per (tick, cluster) slot forever and
+    demands more reads than the chunk budget can serve (offered one per
+    cluster every other tick), so the session runs write- and
+    read-saturated for `chunks` chunks. The row carries the PR 8 steady
+    rollup (ChunkTimer) and reconciles against the SERVE program's cost pin
+    (`<preset>/serve_simulate` -- obs/reconcile.py), with CPU rows
+    explicitly non-anchor."""
+    import itertools
+
+    import jax as _jax
+
+    from raft_sim_tpu.obs import ChunkTimer
+    from raft_sim_tpu.obs import reconcile as _rec
+    from raft_sim_tpu.serve import ServeSession, Tenant
+
+    cfg, preset_batch = PRESETS[preset]
+    if batch is None:
+        batch = min(preset_batch, 64) if smoke else preset_batch
+    if not cfg.read_index:
+        raise ValueError(f"serve bench needs a read-carrying preset, "
+                         f"got {preset}")
+    from raft_sim_tpu.serve.tenancy import split_even
+
+    sizes = split_even(batch, tenants_n)
+    counter = itertools.count(1)
+    tenants = [
+        Tenant(f"t{i}", sizes[i],
+               source=(next(counter) for _ in itertools.repeat(0)),
+               reads=10**9, read_every=2)
+        for i in range(tenants_n)
+    ]
+    perf = ChunkTimer(label="serve-bench", batch=batch)
+    sess = ServeSession(cfg, batch=batch, seed=0, chunk=chunk, window=window,
+                        sink=None, warmup_ticks=chunk, perf=perf,
+                        tenants=tenants)
+    stats = sess.serve(chunks=chunks)
+    rollup = stats["perf"]
+    wall = stats["wall_s"]
+    row = {
+        "kind": "serve-throughput",
+        "unit": "commands+reads/s",
+        "config": preset,
+        "backend": _jax.default_backend(),
+        "smoke": bool(smoke),
+        "batch": batch,
+        "tenants": tenants_n,
+        "chunk": chunk,
+        "window": window,
+        "chunks": stats["chunks"],
+        "ticks": stats["ticks"],
+        "commands_acked": stats["commands_acked"],
+        "reads_served": stats["reads_served"],
+        "ops_done": stats["ops_done"],
+        "ops_per_s": round(stats["ops_done"] / wall, 1) if wall else None,
+        "commands_per_s": (
+            round(stats["commands_acked"] / wall, 1) if wall else None
+        ),
+        "reads_per_s": (
+            round(stats["reads_served"] / wall, 1) if wall else None
+        ),
+        "violations": stats["violations"],
+        "steady_ticks_per_s": rollup["steady_cluster_ticks_per_s"],
+        "perf": rollup,
+    }
+    row["reconciliation"] = _rec.reconcile_row(
+        preset, row, _rec.load_pins(), program="serve_simulate"
+    )
     return row
 
 
@@ -567,6 +647,17 @@ def main() -> None:
                     help="with --measurement-pass: the preset the fault-"
                          "lattice and serve-plane A/Bs run on (default "
                          "config3, the north-star workload)")
+    ap.add_argument("--serve", action="store_true",
+                    help="bench ONLY the standing serve-throughput row "
+                         "(commands+reads/s over a saturated multi-tenant "
+                         "ServeSession; reconciles against the serve "
+                         "program's cost pin). The full matrix run appends "
+                         "this row automatically")
+    ap.add_argument("--serve-preset", default="config9", metavar="NAME",
+                    help="read-carrying preset the serve row runs "
+                         "(default config9, the lease-read tier)")
+    ap.add_argument("--serve-chunks", type=int, default=8,
+                    help="serving chunks of the serve row (default 8)")
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="write the FULL matrix JSON to PATH and print only a "
                          "short headline line (north-star ratio + per-config "
@@ -583,6 +674,12 @@ def main() -> None:
                      "is exclusive with --preset/--scenario/--batch/--ticks "
                      "(use --configs/--ab-preset/--full to steer it)")
         sys.exit(measurement_pass(args))
+
+    if args.serve:
+        row = serve_bench(args.serve_preset, batch=args.batch,
+                          chunks=args.serve_chunks, smoke=args.smoke)
+        print(json.dumps(row))
+        return
 
     scenario = None
     if args.scenario:
@@ -625,6 +722,17 @@ def main() -> None:
                              telemetry_dir=args.telemetry_dir, config_name=name,
                              scenario=scenario, smoke=args.smoke)
 
+    if not args.preset:
+        # The standing serve-throughput row rides every full-matrix run:
+        # ROADMAP item 2's done-bar is commands+reads/s, not ticks/s.
+        # bench_anchor ignores it (no cluster_ticks_per_s key): a service
+        # row can never rebase the tick roofline.
+        print(f"bench {args.serve_preset}-serve: serve-throughput row...",
+              file=sys.stderr)
+        matrix[f"{args.serve_preset}-serve"] = serve_bench(
+            args.serve_preset, chunks=args.serve_chunks, smoke=args.smoke
+        )
+
     # The headline is the north-star workload (config3) whenever it ran; benching a
     # different single preset labels itself via "workload" so vs_baseline is never
     # silently misread as the config3 number.
@@ -643,7 +751,10 @@ def main() -> None:
             json.dump(doc, f, indent=1)
             f.write("\n")
         per_cfg = " ".join(
-            f"{name}={row['cluster_ticks_per_s']:g}" for name, row in matrix.items()
+            f"{name}={row['cluster_ticks_per_s']:g}"
+            if "cluster_ticks_per_s" in row
+            else f"{name}={row.get('ops_per_s', 0):g}ops/s"
+            for name, row in matrix.items()
         )
         print(
             f"{headline_name} {headline['cluster_ticks_per_s']:g} "

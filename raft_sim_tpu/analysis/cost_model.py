@@ -437,7 +437,7 @@ def entry_points():
     seed = jax.ShapeDtypeStruct((), jnp.int32)
     genome = jaxpr_audit._genome_avals(_TINY_BATCH, 2)
     serve_cfg = _dc.replace(_TINY_CFG, serve_ingest=True)
-    cmds = jax.ShapeDtypeStruct((_TINY_TICKS,), jnp.int32)
+    cmds = jax.ShapeDtypeStruct((_TINY_TICKS, _TINY_BATCH), jnp.int32)
     return (
         ("sim.chunked._chunk_donate", "donated",
          lambda: chunked._chunk_donate.lower(
@@ -447,7 +447,7 @@ def entry_points():
              _TINY_CFG, state, keys, None, _TINY_TICKS, _TINY_TICKS, 0, None, 1)),
         ("serve.loop._serve_chunk", "donated",
          lambda: serve_loop._serve_chunk.lower(
-             serve_cfg, state, keys, cmds, _TINY_TICKS)),
+             serve_cfg, state, keys, cmds, None, _TINY_TICKS)),
         ("sim.chunked._chunk", "not-donated",
          lambda: chunked._chunk.lower(
              _TINY_CFG, state, keys, _TINY_TICKS, None, 1)),

@@ -114,6 +114,13 @@ FORK_PAIRS: tuple[tuple[str, dict], ...] = (
         "reconfig_interval": 53, "transfer_interval": 31, "read_interval": 5,
         "drop_prob": 0.15,
     }),
+    # Lease reads: the lease TERM is a tuning knob inside its structural
+    # gate (read_lease_ticks > 0, under the skew-safe ceiling) exactly like
+    # the cadences -- retiming the lease must never fork a compile.
+    ("config9", {
+        "read_lease_ticks": 3, "read_interval": 5, "client_interval": 6,
+        "clock_skew_prob": 0.2,
+    }),
 )
 
 
@@ -205,16 +212,26 @@ def serve_scan_jaxpr(
 ):
     """ClosedJaxpr of the standing-fleet serve program
     (`serve.loop.simulate_serve`: init + served windowed scan). The offer
-    plane enters as a [ticks] int32 aval -- command VALUES are invisible to
-    lowering, so one compiled chunk program serves the whole session and a
+    plane enters as a [ticks, batch] int32 aval (the batch axis IS the
+    tenancy axis: each cluster gets its tenant's own command per tick) --
+    command VALUES and the tenant PARTITION are invisible to lowering, so one
+    compiled chunk program serves the whole session at any tenant count and a
     multi-chunk `driver serve` run compiles nothing after warmup (the claim
-    the distinct-lowering pin gates). NOTE: callers pass the SERVE-mode
-    config (`serve_variant`), which is also the config the carry rules run
-    under -- the offer-tick plane legs move here by design."""
+    the distinct-lowering pin gates). Read-carrying serve variants
+    (cfg.read_index: serve_reads / a scheduled cadence collapsed by
+    serve_config) additionally take the [ticks, batch] read plane. NOTE:
+    callers pass the SERVE-mode config (`serve_variant`), which is also the
+    config the carry rules run under -- the offer-tick plane legs move here
+    by design."""
     from raft_sim_tpu.serve import loop as serve_loop
 
     seed = jax.ShapeDtypeStruct((), jnp.int32)
-    cmds = jax.ShapeDtypeStruct((ticks,), jnp.int32)
+    cmds = jax.ShapeDtypeStruct((ticks, batch), jnp.int32)
+    if cfg.read_index:
+        reads = jax.ShapeDtypeStruct((ticks, batch), jnp.int32)
+        return jax.make_jaxpr(
+            lambda s, c, r: serve_loop.simulate_serve(cfg, s, batch, c, window, r)
+        )(seed, cmds, reads)
     return jax.make_jaxpr(
         lambda s, c: serve_loop.simulate_serve(cfg, s, batch, c, window)
     )(seed, cmds)
@@ -587,9 +604,11 @@ def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
 # (config6), redirect pipeline (config6r).
 # config8 adds the reconfiguration-plane family (joint-consensus membership +
 # TimeoutNow + ReadIndex legs live).
+# config9 adds the lease-read family (lease serve predicate, vote denial,
+# read_fr staleness leg -- compaction + offer-tick plane live too).
 AUDIT_CONFIGS = (
     "config1", "config3", "config4", "config5", "config6", "config6r",
-    "config8",
+    "config8", "config9",
 )
 
 

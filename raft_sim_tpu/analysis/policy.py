@@ -196,7 +196,11 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
     (`scenario_genome_leaves`), not carry."""
     inv = set()
     if not cfg.pre_vote:
-        inv |= {"mb.pv_grant", "heard_clock"}
+        inv |= {"mb.pv_grant"}
+        if not cfg.read_lease:
+            # heard_clock feeds the pre-vote quiet rule AND the lease vote
+            # denial: either gate keeps it live.
+            inv |= {"heard_clock"}
     if not cfg.compaction:
         inv |= {
             "mb.req_base", "mb.req_base_term", "mb.req_base_chk",
@@ -229,6 +233,10 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
             "read_idx", "read_tick", "read_acks",
             "metric.reads_served", "metric.read_lat_sum", "metric.read_hist",
         }
+    if not cfg.read_lease:
+        # The lease staleness anchor is dead weight on plain ReadIndex
+        # configs too -- only the lease gate maintains it.
+        inv |= {"read_fr"}
     return inv
 
 

@@ -431,6 +431,65 @@ class Session:
             committed = fresh()
         return {"accepted": accepted, "committed": committed, "waited": waited}
 
+    def offer_read(self, wait: int = 0) -> dict:
+        """Offer one ReadIndex read and advance one tick -- the read-side
+        Session.offer (the `Session.offer_read` verb docs/SERVE.md named as
+        the missing follow-up). Overrides that tick's scheduled read input
+        via the same shared tick body (scan.tick_batch_minor read_cmd=).
+
+        The ack path mirrors offer()'s delta-stream acks at the read side's
+        natural granularity: a write is acked when the commit-delta stream
+        delivers its (value, stamp) pair; a read produces no log entry, so
+        its ack is the served-read COUNTER advancing (reads are fungible --
+        StepInfo.reads_served, the same counter the serve loop's per-tenant
+        read crediting reads). Returns {"captured", "served", "waited"}:
+        `captured` counts clusters whose leader captured the read on the
+        offer tick (a leaderless or busy-slotted cluster drops it -- retry),
+        `served` counts clusters whose read was served within `wait` further
+        ticks (confirmation round, or the lease fast path under
+        cfg.read_lease). Requires the ReadIndex plane (cfg.read_index:
+        read_interval > 0 or serve_reads)."""
+        if self._trace_spec is not None:
+            # Same hole as offer(): out-of-scan ticks would punch undetectable
+            # monotone-tick gaps into the armed trace stream.
+            raise RuntimeError(
+                "Session.offer_read() ticks are not covered by the armed "
+                "trace stream; detach the trace, or ingest reads via the "
+                "scheduled cadence / the serve loop instead"
+            )
+        if not self.cfg.read_index:
+            raise ValueError(
+                "offer_read needs the ReadIndex plane: set read_interval > 0 "
+                "or serve_reads=True (utils/config.py)"
+            )
+        before = np.asarray(self.metrics.reads_served).astype(np.int64).copy()
+        stamp = int(np.asarray(self.state.now).ravel()[0]) + 1
+        self.state, self.metrics = _offer_read_tick(
+            self.cfg, self.state, self.keys, self.metrics
+        )
+        if self.apply_writer is not None:
+            self.apply_writer.update(self.state)
+        # Captures from THIS offer only: a fresh capture stamps read_tick
+        # with the offer tick + 1 (older pending slots -- e.g. config9's
+        # scheduled cadence -- carry earlier stamps and must not count).
+        captured = int(np.sum(np.any(
+            (np.asarray(self.state.read_idx) > 0)
+            & (np.asarray(self.state.read_tick) == stamp),
+            axis=1,
+        )))
+
+        def served_now() -> int:
+            return int(
+                np.sum(np.asarray(self.metrics.reads_served) - before)
+            )
+
+        served, waited = served_now(), 0
+        while waited < wait and served < self.batch:
+            self.run(1, chunk=1)
+            waited += 1
+            served = served_now()
+        return {"captured": captured, "served": served, "waited": waited}
+
     def _committed_mask(self, value: int) -> np.ndarray:
         """[batch] bool: clusters in which `value` is a committed live entry
         (host-side ring scan; entries compacted past the base are no longer
@@ -507,6 +566,18 @@ class Session:
 @functools.lru_cache(maxsize=8)
 def _traced_run(cfg: RaftConfig, n_ticks: int):
     return jax.jit(lambda s, k: scan.run(cfg, s, k, n_ticks, trace_states=True))
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _offer_read_tick(cfg: RaftConfig, state, keys, metrics):
+    """One tick with a ReadIndex read offered (Session.offer_read), through
+    the same shared tick body as the scan loop."""
+    from raft_sim_tpu.models import raft_batched
+
+    s_t = raft_batched.to_batch_minor(state)
+    m_t = raft_batched.to_batch_minor(metrics)
+    s2, m2, _ = scan.tick_batch_minor(cfg, s_t, keys, m_t, read_cmd=1)
+    return raft_batched.from_batch_minor(s2), raft_batched.from_batch_minor(m2)
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -732,12 +803,47 @@ def _scenario_shrink(args, ap) -> int:
     return 0
 
 
+def _shard_round_robin(it, weights: list[int]):
+    """Split one lazy payload iterator into len(weights) shard iterators,
+    dealing commands in weighted round-robin order (shard i gets weights[i]
+    consecutive commands per cycle) -- how `serve --tenants N` divides a
+    single JSONL stream among tenants. Weighting by each tenant's cluster
+    count matters beyond fairness: consumption per chunk is proportional to
+    cluster count, so a uniform deal against unequal slices would grow the
+    smaller tenants' buffers by ~one command per tick FOREVER; the weighted
+    deal keeps every queue bounded by one chunk's imbalance."""
+    from collections import deque
+
+    src = iter(it)
+    order = [i for i, w in enumerate(weights) for _ in range(w)]
+    queues = [deque() for _ in weights]
+    turn = [0]  # position in the weighted deal order
+
+    def shard(i: int):
+        while True:
+            if queues[i]:
+                yield queues[i].popleft()
+                continue
+            try:
+                v = next(src)
+            except StopIteration:
+                return
+            queues[order[turn[0]]].append(v)
+            turn[0] = (turn[0] + 1) % len(order)
+
+    return [shard(i) for i in range(len(weights))]
+
+
 def _serve(args, ap) -> int:
     """`serve`: the standing-fleet service loop (docs/SERVE.md). A long-lived
     fleet accepts streamed client commands between chunks (JSONL source, '-'
     = stdin) and continuously streams telemetry windows + commit deltas to
     the schema'd sink. Zero recompiles after the first chunk: the chunk
-    program is fixed, commands are data."""
+    program is fixed, commands are data. `--tenants N` partitions the
+    cluster range among N tenants (the batch axis is the tenancy axis: same
+    compiled program at every N), sharding the command stream round-robin;
+    `--reads-per-tenant R` adds R ReadIndex reads to each tenant's demand
+    (requires a read-carrying config, e.g. config9)."""
     from raft_sim_tpu.parallel import summarize
     from raft_sim_tpu.serve import CommandSource, ServeSession, jsonl_commands
     from raft_sim_tpu.serve.loop import serve_config
@@ -765,21 +871,56 @@ def _serve(args, ap) -> int:
         from raft_sim_tpu.obs import ChunkTimer
 
         perf = ChunkTimer(label="serve", batch=batch, sink=sink)
+    tenants = None
+    if args.reads_per_tenant < 0:
+        ap.error("--reads-per-tenant must be >= 0")
+    if args.tenants is not None and not 1 <= args.tenants <= batch:
+        ap.error(f"--tenants must be in [1, batch={batch}]")
+    if args.tenants is not None or args.reads_per_tenant:
+        from raft_sim_tpu.serve.tenancy import Tenant
+
+        if args.tenants is None:
+            # --reads-per-tenant alone: ONE tenant whose writes keep the
+            # legacy broadcast semantics (each command to every cluster) --
+            # a read demand must never silently reshape the write path.
+            tenants = [
+                Tenant("tenant0", batch,
+                       source=jsonl_commands(args.source),
+                       reads=args.reads_per_tenant, broadcast=True)
+            ]
+        else:
+            # Explicit --tenants N (N = 1 included): the partitioned form,
+            # command stream sharded round-robin, one slot per
+            # (tick, cluster).
+            from raft_sim_tpu.serve.tenancy import split_even
+
+            n_ten = args.tenants
+            sizes = split_even(batch, n_ten)
+            shards = _shard_round_robin(jsonl_commands(args.source), sizes)
+            tenants = [
+                Tenant(f"tenant{i}", sizes[i], source=shards[i],
+                       reads=args.reads_per_tenant)
+                for i in range(n_ten)
+            ]
     try:
         sess = ServeSession(
             cfg, batch=batch, seed=args.seed or 0, chunk=args.chunk,
             window=args.window, delta_depth=args.delta_depth, sink=sink,
-            warmup_ticks=args.warmup, perf=perf,
+            warmup_ticks=args.warmup, perf=perf, tenants=tenants,
         )
     except ValueError as ex:
         ap.error(str(ex))
-    source = CommandSource(jsonl_commands(args.source))
+    source = (
+        None if tenants is not None
+        else CommandSource(jsonl_commands(args.source))
+    )
 
     def progress(st):
         if args.progress:
             print(
                 f"  chunk {st['chunks']}: {st['ticks']} ticks, "
                 f"{st['deltas_exported']} deltas, "
+                f"{st['reads_served']} reads, "
                 f"violations={st['violations']}",
                 file=sys.stderr,
             )
@@ -798,6 +939,10 @@ def _serve(args, ap) -> int:
         out["cluster_ticks_per_s"] = round(
             batch * stats["ticks"] / stats["wall_s"], 1
         )
+        # The service's own throughput unit: completed work (committed
+        # entries exported + reads served) per second -- the bench serve
+        # row's headline (commands+reads/s), never ticks.
+        out["ops_per_s"] = round(stats["ops_done"] / stats["wall_s"], 1)
     if args.sink:
         out["sink"] = args.sink
     print(json.dumps(out))
@@ -923,6 +1068,17 @@ def main(argv=None) -> int:
     serve_p.add_argument("--warmup", type=int, default=0, metavar="TICKS",
                          help="ticks simulated before the first offer (elect "
                               "leaders so early offers are not dropped)")
+    serve_p.add_argument("--tenants", type=int, default=None, metavar="N",
+                         help="partition the fleet's cluster range among N "
+                              "logical tenants (per-tenant sources, sinks, "
+                              "and read demands; one compiled program at "
+                              "any N -- serve/tenancy.py). The command "
+                              "stream is sharded round-robin")
+    serve_p.add_argument("--reads-per-tenant", type=int, default=0,
+                         metavar="R",
+                         help="ReadIndex reads each tenant must get served "
+                              "(re-offered until acked; requires a "
+                              "read-carrying config, e.g. --preset config9)")
     serve_p.add_argument("--delta-depth", type=int, default=64,
                          help="per-cluster commit-delta buffer depth per "
                               "extraction round (backpressure bound, not a "

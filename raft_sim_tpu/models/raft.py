@@ -105,6 +105,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     rcf = cfg.reconfig  # static: joint-consensus membership plane active
     xfr = cfg.leader_transfer  # static: TimeoutNow transfer plane active
     rdx = cfg.read_index  # static: ReadIndex read traffic class active
+    rdl = cfg.read_lease  # static: lease-based reads (thesis 6.4.1) active
     ids = jnp.arange(n, dtype=jnp.int32)
     eye = jnp.eye(n, dtype=bool)
     eye_p = bitplane.eye(n)  # [N, W] packed self-bit rows (votes plane layout)
@@ -132,8 +133,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
-    if cfg.pre_vote:
-        # A restarted node remembers no leader contact: "quiet" immediately.
+    if cfg.pre_vote or rdl:
+        # A restarted node remembers no leader contact: "quiet" immediately
+        # (pre-votes grantable, and -- under the lease gate -- real votes
+        # too: a restarted voter holds no lease obligation).
         s = s._replace(
             heard_clock=jnp.where(
                 rs, s.clock - cfg.election_min_ticks, s.heard_clock
@@ -149,6 +152,9 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
             read_tick=jnp.where(rs, 0, s.read_tick),
             read_acks=jnp.where(rs[:, None], zw, s.read_acks),
         )
+        if rdl:
+            # The staleness anchor dies with the slot it anchors.
+            s = s._replace(read_fr=jnp.where(rs, 0, s.read_fr))
     mb = s.mailbox
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk
 
@@ -244,6 +250,19 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         & (mb.req_last_index[:, None] >= my_last_idx[None, :])
     )
     can_grant = cur_rv & up_to_date
+    if rdl:
+        # Lease vote denial (thesis 4.2.3, the rule 6.4.1's lease leans on):
+        # a voter that heard from a current leader within the minimum
+        # election timeout on its LOCAL clock denies RequestVote outright --
+        # so a leader whose heartbeats a quorum acked L ticks ago KNOWS no
+        # election can complete for election_min_ticks/2 more global ticks
+        # (local clocks advance at most 2/tick under skew; the config
+        # validator pins the lease term under that bound). Judged against
+        # the TICK-START heard_clock -- this tick's AppendEntries land in
+        # phase 3, after votes -- which only SHORTENS the denial window by
+        # one tick; the validator's +4 slack covers it (docs/PROTOCOL.md).
+        lease_quiet = (s.clock + inp.skew) - s.heard_clock < cfg.election_min_ticks
+        can_grant = can_grant & ~lease_quiet[None, :]
     # At most one grant per node per tick: the lowest eligible candidate id wins the
     # race (the reference serializes naturally, one message per wait iteration).
     lowest = jnp.min(jnp.where(can_grant, snd_ids, n), axis=0)  # [N], n = none
@@ -437,17 +456,21 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # and we are QUIET: not a leader ourselves and no valid AppendEntries
     # accepted within the minimum election timeout (including this tick's).
     # Grants are non-binding: no votedFor, no term change, no timer reset.
-    if cfg.pre_vote:
+    if cfg.pre_vote or rdl:
+        # heard_clock maintenance serves two consumers: the pre-vote quiet
+        # rule (below) and the lease vote denial (phase 2) -- either gate
+        # keeps the leg live.
         clock_pv = s.clock + inp.skew  # phase 7's clock; duplicated, CSE'd
         heard = jnp.where(has_ae, clock_pv, s.heard_clock)  # [N]
+    else:
+        heard = s.heard_clock
+    if cfg.pre_vote:
         is_pv = req_in & (mb.req_type == REQ_PREVOTE)[:, None]  # [cand, voter]
         quiet = (clock_pv - heard >= cfg.election_min_ticks) & (role != LEADER)
         pv_grant = (
             is_pv & (mb.req_term[:, None] >= term[None, :]) & up_to_date & quiet[None, :]
         )
         pv_out = is_pv
-    else:
-        heard = s.heard_clock
 
     # ---- phase 3.7: TimeoutNow receipt (thesis 3.10; cfg.leader_transfer) --------
     # The transfer target starts a REAL election IMMEDIATELY: no timer, no
@@ -716,6 +739,27 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
             # partition serves reads from its stale commit state (the
             # below-the-committed-frontier read the checker must reject).
             serve = keep_r & inp.alive
+        if rdl:
+            # Lease fast path (thesis 6.4.1): a leader holding a fresh
+            # configuration quorum of AppendEntries acks -- every member
+            # acked within the lease window on the GLOBAL tick clock (the
+            # ack_age plane ages 1/tick regardless of skew; the leader's
+            # own skewable clock is never consulted) -- serves immediately,
+            # no confirmation round. The TEST-ONLY lease_skew_safe mutant
+            # widens the window to election_min_ticks + 2: the no-skew
+            # bound -- on 1:1 clocks a deposing election needs a full
+            # election_min of denial expiry plus the vote+commit round
+            # trips, and a capture must precede its serve by a tick, so the
+            # widened lease still cannot produce a stale serve; under clock
+            # skew the denial window halves in global time and it can.
+            lease_w = (
+                cfg.read_lease_ticks
+                if cfg.lease_skew_safe
+                else cfg.election_min_ticks + 2
+            )
+            fresh_p = bitplane.pack(ack_age <= lease_w, axis=1)  # [N, W]
+            lease_ok = packed_quorum(fresh_p | eye_p)
+            serve = serve | (keep_r & inp.alive & lease_ok)
         lat_r = jnp.maximum(s.now + 1 - s.read_tick, 1)  # [N]
         reads_served = jnp.sum(serve).astype(jnp.int32)
         read_lat_sum = jnp.sum(jnp.where(serve, lat_r, 0)).astype(jnp.int32)
@@ -744,6 +788,25 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         read_idx = jnp.where(cap_r, commit + 1, jnp.where(cleared, 0, s.read_idx))
         read_tick = jnp.where(cap_r, s.now + 1, jnp.where(cleared, 0, s.read_tick))
         read_acks = jnp.where((cap_r | serve)[:, None], zw, read_acks)
+        if rdl:
+            # Staleness anchor: bank the committed frontier (lat_frontier
+            # semantics, incl. this tick's phase-5 advance) at capture; a
+            # SERVE whose captured index sits below its banked frontier
+            # missed committed writes -- the checker's read_linearizability
+            # property as a device invariant, so the hunt's fitness sees
+            # lease violations. Exact, not conservative: a legitimate
+            # (confirmed or leased) leader's capture covers the frontier by
+            # the current-term-commit gate, so the real kernel never flags.
+            fr_now = jnp.maximum(s.lat_frontier, jnp.max(commit))
+            read_fr = jnp.where(
+                cap_r, fr_now, jnp.where(cleared, 0, s.read_fr)
+            )
+            if cfg.check_invariants:
+                viol_read_stale = jnp.any(serve & (s.read_idx - 1 < s.read_fr))
+            else:
+                viol_read_stale = np.zeros((), np.bool_)
+        else:
+            viol_read_stale = np.zeros((), np.bool_)
     else:
         # Constants, not jnp.zeros: a zeros op would land in the lowered
         # step program and break the zero-cost-when-off golden (byte-
@@ -751,6 +814,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         reads_served = np.int32(0)
         read_lat_sum = np.int32(0)
         read_hist = np.zeros((LAT_HIST_BINS,), np.int32)
+        viol_read_stale = np.zeros((), np.bool_)
 
     # ---- offer->commit latency (client workloads only) ---------------------------
     # Each client entry's offer stamp rides the log_tick plane (phase 6 writes
@@ -1199,6 +1263,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         read_idx=read_idx if rdx else s.read_idx,
         read_tick=read_tick if rdx else s.read_tick,
         read_acks=read_acks if rdx else s.read_acks,
+        read_fr=read_fr if rdl else s.read_fr,
         client_pend=client_pend,
         client_dst=client_dst,
         client_tick=client_tick,
@@ -1210,7 +1275,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     info = _step_info(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
-        reads_served, read_lat_sum, read_hist,
+        reads_served, read_lat_sum, read_hist, viol_read_stale,
     )
     return new_state, info
 
@@ -1232,6 +1297,7 @@ def _step_info(
     reads_served: jax.Array,
     read_lat_sum: jax.Array,
     read_hist: jax.Array,
+    viol_read_stale: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
     n = cfg.n_nodes
@@ -1361,4 +1427,5 @@ def _step_info(
         reads_served=reads_served,
         read_lat_sum=read_lat_sum,
         read_hist=read_hist,
+        viol_read_stale=viol_read_stale,
     )

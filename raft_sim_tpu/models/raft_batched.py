@@ -70,6 +70,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     rcf = cfg.reconfig  # static: joint-consensus membership plane active
     xfr = cfg.leader_transfer  # static: TimeoutNow transfer plane active
     rdx = cfg.read_index  # static: ReadIndex read traffic class active
+    rdl = cfg.read_lease  # static: lease-based reads (thesis 6.4.1) active
     b = s.role.shape[-1]
     # All iota-style constants are built at their final rank (log_ops.iota): Mosaic
     # cannot lower unit-dim-appending reshapes, and this module doubles as the
@@ -96,8 +97,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
-    if cfg.pre_vote:
-        # A restarted node remembers no leader contact: "quiet" immediately.
+    if cfg.pre_vote or rdl:
+        # A restarted node remembers no leader contact: "quiet" immediately
+        # (pre-votes grantable, and -- under the lease gate -- real votes
+        # too: a restarted voter holds no lease obligation).
         s = s._replace(
             heard_clock=jnp.where(
                 rs, s.clock - cfg.election_min_ticks, s.heard_clock
@@ -113,6 +116,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             read_tick=jnp.where(rs, 0, s.read_tick),
             read_acks=jnp.where(rs2, zw, s.read_acks),
         )
+        if rdl:
+            # The staleness anchor dies with the slot it anchors.
+            s = s._replace(read_fr=jnp.where(rs, 0, s.read_fr))
     mb = s.mailbox
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk  # [N, B]
 
@@ -191,6 +197,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         & (mb.req_last_index[:, None, :] >= my_last_idx[None, :, :])
     )
     can_grant = cur_rv & up_to_date
+    if rdl:
+        # Lease vote denial (thesis 4.2.3; raft.py phase 2 for the full
+        # staleness argument): deny while a current leader was heard within
+        # the minimum election timeout on the voter's LOCAL clock.
+        lease_quiet = (
+            (s.clock + inp.skew) - s.heard_clock < cfg.election_min_ticks
+        )  # [N, B]
+        can_grant = can_grant & ~lease_quiet[None, :, :]
     lowest = jnp.min(jnp.where(can_grant, snd_ids, n), axis=0)  # [N, B]
     # Boolean arithmetic instead of where-on-bools: Mosaic cannot lower vector
     # selects with i1 operands.
@@ -361,9 +375,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_a_hint = log_len.astype(idt)  # post-append, pre-injection (phase 6 rebinds)
 
     # ---- phase 3.5: PreVote requests (thesis 9.6; raft.py) -----------------------
-    if cfg.pre_vote:
+    if cfg.pre_vote or rdl:
+        # heard_clock serves the pre-vote quiet rule AND the lease vote
+        # denial (phase 2) -- either gate keeps the leg live (raft.py).
         clock_pv = s.clock + inp.skew  # phase 7's clock; duplicated, CSE'd
         heard = jnp.where(has_ae, clock_pv, s.heard_clock)  # [N, B]
+    else:
+        heard = s.heard_clock
+    if cfg.pre_vote:
         is_pv = req_in & (mb.req_type == REQ_PREVOTE)[:, None, :]  # [cand, voter, B]
         quiet = (clock_pv - heard >= cfg.election_min_ticks) & (role != LEADER)
         pv_grant = (
@@ -373,8 +392,6 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             & quiet[None, :, :]
         )
         pv_out = is_pv
-    else:
-        heard = s.heard_clock
 
     # ---- phase 3.7: TimeoutNow receipt (thesis 3.10; raft.py) --------------------
     if xfr:
@@ -599,6 +616,18 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             serve = keep_r & inp.alive & packed_quorum(read_acks | eye_p3)
         else:
             serve = keep_r & inp.alive  # TEST-ONLY mutant: no confirmation
+        if rdl:
+            # Lease fast path on the global-tick ack_age plane; the
+            # lease_skew_safe mutant widens the window to the no-skew bound
+            # election_min_ticks + 2 (raft.py phase 5 for the argument).
+            lease_w = (
+                cfg.read_lease_ticks
+                if cfg.lease_skew_safe
+                else cfg.election_min_ticks + 2
+            )
+            fresh_p = bitplane.pack(ack_age <= lease_w, axis=1)  # [N, W, B]
+            lease_ok = packed_quorum(fresh_p | eye_p3)
+            serve = serve | (keep_r & inp.alive & lease_ok)
         lat_r = jnp.maximum(s.now[None, :] + 1 - s.read_tick, 1)  # [N, B]
         reads_served = jnp.sum(serve, axis=0).astype(jnp.int32)
         read_lat_sum = jnp.sum(jnp.where(serve, lat_r, 0), axis=0).astype(jnp.int32)
@@ -626,12 +655,27 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             cap_r, s.now[None, :] + 1, jnp.where(cleared, 0, s.read_tick)
         )
         read_acks = jnp.where((cap_r | serve)[:, None, :], zw, read_acks)
+        if rdl:
+            # Staleness anchor + device invariant (raft.py phase 5).
+            fr_now = jnp.maximum(s.lat_frontier, jnp.max(commit, axis=0))  # [B]
+            read_fr = jnp.where(
+                cap_r, fr_now[None, :], jnp.where(cleared, 0, s.read_fr)
+            )
+            if cfg.check_invariants:
+                viol_read_stale = jnp.any(
+                    serve & (s.read_idx - 1 < s.read_fr), axis=0
+                )
+            else:
+                viol_read_stale = np.zeros((b,), np.bool_)
+        else:
+            viol_read_stale = np.zeros((b,), np.bool_)
     else:
         # Constants, not jnp.zeros: keep the disabled-mode lowered program
         # byte-identical (see raft.py).
         reads_served = np.zeros((b,), np.int32)
         read_lat_sum = np.zeros((b,), np.int32)
         read_hist = np.zeros((LAT_HIST_BINS, b), np.int32)
+        viol_read_stale = np.zeros((b,), np.bool_)
 
     # ---- offer->commit latency (client workloads only; raft.py) ------------------
     if track:
@@ -1001,6 +1045,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         read_idx=read_idx if rdx else s.read_idx,
         read_tick=read_tick if rdx else s.read_tick,
         read_acks=read_acks if rdx else s.read_acks,
+        read_fr=read_fr if rdl else s.read_fr,
         client_pend=client_pend,
         client_dst=client_dst,
         client_tick=client_tick,
@@ -1012,7 +1057,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     info = _step_info_b(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
-        reads_served, read_lat_sum, read_hist,
+        reads_served, read_lat_sum, read_hist, viol_read_stale,
     )
     return new_state, info
 
@@ -1034,6 +1079,7 @@ def _step_info_b(
     reads_served: jax.Array,
     read_lat_sum: jax.Array,
     read_hist: jax.Array,
+    viol_read_stale: jax.Array,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
@@ -1156,4 +1202,5 @@ def _step_info_b(
         reads_served=reads_served,
         read_lat_sum=read_lat_sum,
         read_hist=read_hist,
+        viol_read_stale=viol_read_stale,
     )

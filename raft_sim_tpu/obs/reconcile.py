@@ -90,11 +90,15 @@ def non_anchor_reasons(config_name: str, row: dict,
 
 def reconcile_row(config_name: str, row: dict, pins: dict,
                   default_backend: str | None = None,
-                  observed_live_bytes: int | None = None) -> dict:
-    """Join one measured bench row against its config's `simulate` pin."""
+                  observed_live_bytes: int | None = None,
+                  program: str = "simulate") -> dict:
+    """Join one measured bench row against its config's pinned program
+    (`simulate` for the tick matrix; the serve-throughput row passes
+    `serve_simulate` so its ticks/s reconcile against the SERVE program's
+    bytes/tick -- the offer/read planes and window folds included)."""
     backend = row.get("backend") or default_backend
     measured, source = _measured(row)
-    pin = (pins.get("programs") or {}).get(f"{config_name}/simulate") or {}
+    pin = (pins.get("programs") or {}).get(f"{config_name}/{program}") or {}
     notes = []
     out = {
         "config": config_name,
@@ -118,7 +122,8 @@ def reconcile_row(config_name: str, row: dict, pins: dict,
         )
     if not pin:
         notes.append(
-            f"no cost-model pin for {config_name}/simulate: measurements only"
+            f"no cost-model pin for {config_name}/{program}: "
+            "measurements only"
         )
     if measured and pin.get("bytes_per_tick_padded"):
         out["achieved_bytes_per_s"] = round(

@@ -107,6 +107,7 @@ class ChunkTimer:
         self._t_prev_end = None
         self._ticks = 0
         self._gap = 0.0
+        self._extra: dict = {}
 
     # -------------------------------------------------------------- probes
 
@@ -143,6 +144,14 @@ class ChunkTimer:
         """Call right after the jitted chunk call returns (async dispatch)."""
         self._t_disp = time.perf_counter()
 
+    def annotate(self, **extra) -> None:
+        """Attach loop-measured sub-phase fields (JSON-able values) to the
+        CURRENT chunk's row -- e.g. the serve loop's pack_s/export_s, timed
+        inside its dispatch->sync host window so the overlap structure is a
+        checkable perf.jsonl fact, not prose. Unknown keys ride the row
+        as-is (the sink validates only the core schema fields)."""
+        self._extra.update(extra)
+
     def end(self, sync=None) -> dict:
         """Close the chunk: `sync` forces a host copy of a small chunk output
         (its duration is the device wait); sample memory + jit caches, append
@@ -175,7 +184,9 @@ class ChunkTimer:
             "live_bytes": device_live_bytes(),
             "jit_cache": caches,
             "recompiled": recompiled,
+            **self._extra,
         }
+        self._extra = {}
         self.rows.append(row)
         if self.sink is not None:
             self.sink.append_perf([row])

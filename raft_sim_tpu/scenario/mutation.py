@@ -71,11 +71,29 @@ class BlindTransferConfig(RaftConfig):
         return False
 
 
+class LeaseSkewConfig(RaftConfig):
+    """Lease reads judged on a no-skew clock model (cfg.lease_skew_safe
+    False): the kernel serves lease reads for election_min_ticks + 2 global
+    ticks instead of the configured skew-safe read_lease_ticks. Correct when
+    every local clock advances exactly 1/tick; under clock skew a fast
+    follower's lease-vote-denial window halves in global time, a new leader
+    elects and commits INSIDE the optimistic lease, and the partitioned old
+    leader serves a read below the committed frontier -- viol_read_stale on
+    device (the hunt's fitness signal, driven by the skew genome axis) and a
+    read_linearizability rejection from the trace checker. Requires
+    cfg.read_lease (read_lease_ticks > 0)."""
+
+    @property
+    def lease_skew_safe(self) -> bool:  # type: ignore[override]
+        return False
+
+
 MUTANTS = {
     "weak-quorum": WeakQuorumConfig,
     "joint-bypass": JointBypassConfig,
     "stale-read": StaleReadConfig,
     "blind-transfer": BlindTransferConfig,
+    "lease-skew": LeaseSkewConfig,
 }
 
 

@@ -36,7 +36,10 @@ from raft_sim_tpu.scenario import genome as genome_mod
 from raft_sim_tpu.sim import scan, trace
 from raft_sim_tpu.utils.config import RaftConfig
 
-VIOL_FIELDS = ("viol_election_safety", "viol_commit", "viol_log_matching")
+VIOL_FIELDS = (
+    "viol_election_safety", "viol_commit", "viol_log_matching",
+    "viol_read_stale",
+)
 
 # Ablation groups tried whole-mechanism-first (any order is sound; cheap and
 # usually-removable mechanisms go first so the artifact shrinks fastest), then

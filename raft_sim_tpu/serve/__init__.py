@@ -1,23 +1,36 @@
-"""Fleet-as-a-service: the standing-fleet serve subsystem (ISSUE 6).
+"""Fleet-as-a-service: the standing-fleet serve subsystem (ISSUE 6 + the
+ISSUE 11 tenancy plane).
 
-Three pieces (docs/SERVE.md has the architecture):
+Four pieces (docs/SERVE.md has the architecture):
   ingest.py  -- host command sources packed into per-chunk offer planes
-  loop.py    -- the double-buffered served scan + ServeSession driver
+  loop.py    -- the overlapped served scan + ServeSession driver
   deltas.py  -- device-side commit-delta extraction (the streaming apply/ack
                 surface replacing the host snapshot-diff poll)
+  tenancy.py -- multi-tenant partitioning of the fleet's cluster range
+                (per-tenant sources, read demands, and export streams over
+                ONE compiled program)
 """
 
 from raft_sim_tpu.serve.deltas import DeltaStream, extract
-from raft_sim_tpu.serve.ingest import CommandSource, jsonl_commands, pack_chunk
+from raft_sim_tpu.serve.ingest import (
+    CommandSource,
+    jsonl_commands,
+    pack_chunk,
+    pack_plane,
+)
 from raft_sim_tpu.serve.loop import ServeSession, serve_config, simulate_serve
+from raft_sim_tpu.serve.tenancy import Tenant, TenantRouter
 
 __all__ = [
     "CommandSource",
     "DeltaStream",
     "ServeSession",
+    "Tenant",
+    "TenantRouter",
     "extract",
     "jsonl_commands",
     "pack_chunk",
+    "pack_plane",
     "serve_config",
     "simulate_serve",
 ]

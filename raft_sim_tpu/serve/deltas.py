@@ -104,6 +104,10 @@ class DeltaStream:
         self.depth = depth
         self.watermark = jnp.zeros((batch,), jnp.int32)
         self.exported = 0  # entries exported (incl. no-ops)
+        # Client entries exported (no-ops excluded): the commands-ACKED
+        # count -- what the serve throughput metric reports, so election
+        # churn's protocol filler can never inflate commands+reads/s.
+        self.applied = 0
         self.gap_entries = 0  # entries lost to compaction before export
 
     def skip_to_now(self, state) -> None:
@@ -118,6 +122,32 @@ class DeltaStream:
             self.watermark, jnp.max(state.commit_index, axis=1)
         )
 
+    def _rows_of(self, d: "DeltaBatch") -> list[dict]:
+        """Host-side row building + export accounting for one fetched round
+        (shared by the sync drain loop and the async fixed-round path)."""
+        counts = np.asarray(d.count)
+        gaps = np.asarray(d.gap)
+        rows: list[dict] = []
+        if not counts.any() and not gaps.any():
+            return rows
+        starts = np.asarray(d.start)
+        values = np.asarray(d.values)
+        ticks = np.asarray(d.ticks)
+        for c in np.flatnonzero(counts | gaps):
+            cnt = int(counts[c])
+            vals = [int(v) for v in values[c, :cnt]]
+            rows.append({
+                "cluster": int(c),
+                "start": int(starts[c]) + 1,
+                "gap": int(gaps[c]),
+                "values": vals,
+                "ticks": [int(t) for t in ticks[c, :cnt]],
+            })
+            self.exported += cnt
+            self.applied += sum(1 for v in vals if v != NOOP)
+            self.gap_entries += int(gaps[c])
+        return rows
+
     def drain(self, state, max_rounds: int = 1024) -> list[dict]:
         """Extract until no cluster has pending deltas. Returns one row per
         (cluster, round) with anything new:
@@ -128,27 +158,40 @@ class DeltaStream:
         for _ in range(max_rounds):
             d: DeltaBatch = extract(state, self.watermark, self.depth)
             counts = np.asarray(d.count)
-            gaps = np.asarray(d.gap)
-            if not counts.any() and not gaps.any():
+            new = self._rows_of(d)
+            if not new:
                 break
-            starts = np.asarray(d.start)
-            values = np.asarray(d.values)
-            ticks = np.asarray(d.ticks)
-            for c in np.flatnonzero(counts | gaps):
-                cnt = int(counts[c])
-                row = {
-                    "cluster": int(c),
-                    "start": int(starts[c]) + 1,
-                    "gap": int(gaps[c]),
-                    "values": [int(v) for v in values[c, :cnt]],
-                    "ticks": [int(t) for t in ticks[c, :cnt]],
-                }
-                rows.append(row)
-                self.exported += cnt
-                self.gap_entries += int(gaps[c])
+            rows.extend(new)
             self.watermark = d.watermark
             if int(counts.max(initial=0)) < self.depth:
                 break  # nobody filled the buffer: everyone is dry
+        return rows
+
+    def begin_rounds(self, state, rounds: int) -> list["DeltaBatch"]:
+        """The OVERLAPPED drain's dispatch half: enqueue a fixed number of
+        extraction rounds against `state` (async under jax dispatch -- the
+        serve loop queues them behind the chunk that produced the state and
+        fetches after its sync, so the donation of `state` to the next chunk
+        never races a pending read). `rounds * depth >= commit throughput
+        per chunk` keeps the stream dry in steady state; any remainder is
+        backpressure picked up next chunk, never loss. Advances the
+        watermark to the final round's (a device future)."""
+        futs = []
+        wm = self.watermark
+        for _ in range(rounds):
+            d = extract(state, wm, self.depth)
+            futs.append(d)
+            wm = d.watermark
+        self.watermark = wm
+        return futs
+
+    def finish_rounds(self, futs: list["DeltaBatch"]) -> list[dict]:
+        """The overlapped drain's fetch half: rows from the enqueued rounds
+        (call after the producing chunk's sync; the extractions have then
+        already executed)."""
+        rows: list[dict] = []
+        for d in futs:
+            rows.extend(self._rows_of(d))
         return rows
 
 

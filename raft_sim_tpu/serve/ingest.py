@@ -54,6 +54,26 @@ def pack_chunk(values: list[int], chunk: int) -> np.ndarray:
     return plane
 
 
+def pack_plane(values: list[int], chunk: int, lanes: int) -> np.ndarray:
+    """The per-cluster form of pack_chunk: up to `chunk * lanes` validated
+    payloads into a [chunk, lanes] int32 plane -- one command per (tick,
+    cluster) slot, filled tick-major (lane 0..L-1 of tick 0 first, so a
+    tenant's commands land as early as its lane width allows), NIL-padded.
+    The tenancy router (serve/tenancy.py) packs each tenant's lane slice
+    here, so the validation rules cannot fork from the single-lane path."""
+    if lanes < 1:
+        raise ValueError(f"pack_plane needs >= 1 lane, got {lanes}")
+    if len(values) > chunk * lanes:
+        raise ValueError(
+            f"{len(values)} values do not fit a {chunk}-tick x {lanes}-lane "
+            "chunk"
+        )
+    plane = np.full((chunk, lanes), NIL, np.int32)
+    for i, v in enumerate(values):
+        plane[i // lanes, i % lanes] = check_value(v)
+    return plane
+
+
 def parse_line(raw: str):
     """One JSONL source line -> payload int or None (blank/comment). Accepts a
     bare integer or {"value": <int>} (extra keys ignored, so richer command
@@ -98,12 +118,17 @@ class CommandSource:
         self.exhausted = False
         self.offered = 0
 
-    def next_chunk(self, chunk: int) -> np.ndarray:
+    def next_values(self, n: int) -> list[int]:
+        """Pull up to `n` raw payloads (the tenancy router packs them into
+        its lane slice via pack_plane)."""
         values: list[int] = []
-        while len(values) < chunk and not self.exhausted:
+        while len(values) < n and not self.exhausted:
             try:
                 values.append(next(self._it))
             except StopIteration:
                 self.exhausted = True
         self.offered += len(values)
-        return pack_chunk(values, chunk)
+        return values
+
+    def next_chunk(self, chunk: int) -> np.ndarray:
+        return pack_chunk(self.next_values(chunk), chunk)

@@ -1,0 +1,280 @@
+"""Multi-tenant partitioning of a standing serve fleet.
+
+The serve loop holds B independent Raft clusters as one compiled program; a
+TENANT is a named contiguous slice of that cluster range with its own
+CommandSource, its own ReadIndex demand, and its own export streams. The
+batch axis IS the tenancy axis: the router below turns per-tenant ingest
+queues into the [T, B] per-cluster offer/read planes `run_windowed_served`
+consumes, and splits the per-cluster outputs (window records, delta rows)
+back per tenant -- so adding, removing, or resizing tenants changes HOST
+bookkeeping only. The compiled chunk program never sees the partition
+(shapes are (chunk, B) at every tenant count; tests pin the jit cache flat
+across 1/2/4-tenant sessions).
+
+Export layout under a serving sink directory (docs/OBSERVABILITY.md):
+
+    <dir>/tenants.json                 {name: {"lo": c0, "hi": c1,
+                                        "offered", "acked", "reads_offered",
+                                        "reads_served"}} -- written at the
+                                        end of the session (ServeSession).
+    <dir>/tenants/<name>/windows.jsonl the tenant's cluster slice aggregated
+                                        with the SAME line schema as the
+                                        fleet windows.jsonl (one shared
+                                        aggregation: telemetry_sink.
+                                        window_lines).
+    <dir>/tenants/<name>/deltas.jsonl  the tenant's commit-delta rows, with
+                                        clusters renumbered TENANT-LOCAL
+                                        (cluster - lo), so a tenant's stream
+                                        is self-contained and validates with
+                                        serve.deltas.validate_deltas.
+
+The fleet-level windows.jsonl / deltas.jsonl keep the whole-fleet streams;
+the per-tenant files are views, not replacements.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from raft_sim_tpu.serve import deltas as deltas_mod
+from raft_sim_tpu.serve.ingest import CommandSource, pack_plane
+from raft_sim_tpu.types import NIL, NOOP
+
+
+def split_even(total: int, n: int) -> list[int]:
+    """Balanced contiguous partition sizes: `total` clusters over `n` tenants,
+    remainders to the earliest. THE partition policy -- the serve CLI and the
+    bench serve row both build their tenant lists from it, so a future policy
+    change (weighted tenants, the per-tenant QoS follow-up) is one edit."""
+    if not 1 <= n <= total:
+        raise ValueError(f"cannot split {total} clusters over {n} tenants")
+    return [total // n + (i < total % n) for i in range(n)]
+
+
+class Tenant:
+    """One logical tenant: `clusters` of the fleet's batch range, a command
+    source (any payload iterable / CommandSource; None = read-only tenant),
+    and a ReadIndex demand of `reads` reads offered at most one per cluster
+    every `read_every` ticks. Reads are fungible (no payload), so the
+    tenant's read ack is its served-read count reaching the demand -- the
+    router re-offers until the telemetry windows credit enough serves, which
+    makes dropped offers (leaderless tick, busy read slot) retries, not
+    losses."""
+
+    def __init__(self, name: str, clusters: int, source=None, reads: int = 0,
+                 read_every: int = 2, broadcast: bool = False):
+        if clusters < 1:
+            raise ValueError(f"tenant {name!r} needs >= 1 cluster")
+        if reads < 0:
+            raise ValueError(f"tenant {name!r}: reads must be >= 0")
+        if read_every < 1:
+            raise ValueError(f"tenant {name!r}: read_every must be >= 1")
+        self.name = name
+        self.clusters = clusters
+        if source is not None and not isinstance(source, CommandSource):
+            source = CommandSource(source)
+        self.source = source
+        self.reads = reads
+        self.read_every = read_every
+        # broadcast=True: one logical client over the tenant's whole slice --
+        # each command is offered to EVERY cluster of the slice that tick
+        # (the pre-tenancy ServeSession semantics; serve()'s legacy source
+        # path uses it for its "default" tenant). False: commands spread one
+        # per (tick, cluster) slot, pack_plane order.
+        self.broadcast = broadcast
+        # Assigned by TenantRouter:
+        self.lo = self.hi = 0
+        # Ledgers:
+        self.reads_offered = 0
+        self.reads_served = 0  # credited from collected window records
+        self.acked_values: list[int] = []
+        self.delta_rows: list[dict] = []
+
+    @property
+    def writes_done(self) -> bool:
+        return self.source is None or self.source.exhausted
+
+    @property
+    def reads_done(self) -> bool:
+        return self.reads_served >= self.reads
+
+    @property
+    def offered(self) -> int:
+        return 0 if self.source is None else self.source.offered
+
+
+class TenantRouter:
+    """Partition a B-cluster fleet among tenants and route planes/streams.
+
+    `pack(chunk)` -> (cmds [chunk, B], reads [chunk, B] | None): each
+    tenant's queued commands packed into its lane slice (ingest.pack_plane,
+    the one packing helper) and its outstanding read demand offered at its
+    cadence. `credit_windows(records)` / `route_deltas(rows)` push each
+    chunk's outputs back to the owning tenants (and their sink files, when a
+    directory is attached).
+    """
+
+    def __init__(self, tenants: list[Tenant], batch: int,
+                 reads_enabled: bool):
+        if not tenants:
+            raise ValueError("need at least one tenant")
+        names = [t.name for t in tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names: {names}")
+        total = sum(t.clusters for t in tenants)
+        if total != batch:
+            raise ValueError(
+                f"tenant cluster counts sum to {total}, fleet batch is "
+                f"{batch}: the partition must cover the cluster range exactly"
+            )
+        if any(t.reads for t in tenants) and not reads_enabled:
+            raise ValueError(
+                "a tenant demands reads but the serve config carries no "
+                "ReadIndex plane (cfg.serve_reads / read cadence)"
+            )
+        self.tenants = tenants
+        self.batch = batch
+        self.reads_enabled = reads_enabled
+        lo = 0
+        for t in tenants:
+            t.lo, t.hi = lo, lo + t.clusters
+            lo = t.hi
+        self._by_cluster = np.zeros(batch, np.int32)
+        for i, t in enumerate(tenants):
+            self._by_cluster[t.lo:t.hi] = i
+        self._dir = None
+        self._tenant_windows: dict[str, int] = {}
+        self._read_phase = 0  # global tick phase of the read cadence
+
+    # ------------------------------------------------------------- export IO
+
+    def attach_dir(self, directory: str) -> None:
+        """Arm per-tenant stream files under `directory`/tenants/<name>/
+        (truncated up front, like the fleet streams)."""
+        self._dir = directory
+        for t in self.tenants:
+            d = os.path.join(directory, "tenants", t.name)
+            os.makedirs(d, exist_ok=True)
+            open(os.path.join(d, "windows.jsonl"), "w").close()
+            open(os.path.join(d, "deltas.jsonl"), "w").close()
+            self._tenant_windows[t.name] = 0
+
+    def write_manifest(self, path: str) -> None:
+        doc = {
+            t.name: {
+                "lo": t.lo, "hi": t.hi,
+                "offered": t.offered,
+                "acked": len(t.acked_values),
+                "reads_offered": t.reads_offered,
+                "reads_served": t.reads_served,
+            }
+            for t in self.tenants
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    # ------------------------------------------------------------ plane side
+
+    def pack(self, chunk: int) -> tuple[np.ndarray, np.ndarray | None]:
+        """The next chunk's per-cluster planes from every tenant's queues."""
+        cmds = np.full((chunk, self.batch), NIL, np.int32)
+        reads = (
+            np.full((chunk, self.batch), NIL, np.int32)
+            if self.reads_enabled
+            else None
+        )
+        for t in self.tenants:
+            if t.source is not None and not t.source.exhausted:
+                if t.broadcast:
+                    vals = t.source.next_values(chunk)
+                    cmds[:, t.lo:t.hi] = pack_plane(vals, chunk, 1)
+                else:
+                    vals = t.source.next_values(chunk * t.clusters)
+                    cmds[:, t.lo:t.hi] = pack_plane(vals, chunk, t.clusters)
+            if reads is not None and t.reads_served < t.reads:
+                # Offer up to the OUTSTANDING demand (demand minus serves
+                # already credited -- crediting lags a chunk, so the
+                # over-offer is bounded by one chunk's serves; reads are
+                # fungible and extra serves are harmless), at most one read
+                # per cluster every read_every ticks: dropped offers
+                # re-offer next chunk.
+                want = t.reads - t.reads_served
+                for k in range(chunk):
+                    if want <= 0:
+                        break
+                    if (self._read_phase + k) % t.read_every:
+                        continue
+                    lanes = min(want, t.clusters)
+                    reads[k, t.lo:t.lo + lanes] = 1
+                    t.reads_offered += lanes
+                    want -= lanes
+        self._read_phase = (self._read_phase + chunk) % (2 ** 30)
+        return cmds, reads
+
+    # ----------------------------------------------------------- output side
+
+    def credit_windows(self, records) -> None:
+        """Per-tenant telemetry: slice this chunk's stacked WindowRecord by
+        cluster range, credit served reads against each tenant's demand, and
+        append tenant windows.jsonl lines (the shared window_lines schema)."""
+        import jax
+
+        from raft_sim_tpu.utils.telemetry_sink import window_lines
+
+        for t in self.tenants:
+            sl = jax.tree.map(lambda x: np.asarray(x)[t.lo:t.hi], records)
+            t.reads_served += int(
+                np.asarray(sl.metrics.reads_served, np.int64).sum()
+            )
+            if self._dir is not None:
+                lines = window_lines(sl, self._tenant_windows[t.name])
+                path = os.path.join(
+                    self._dir, "tenants", t.name, "windows.jsonl"
+                )
+                with open(path, "a") as f:
+                    for line in lines:
+                        f.write(json.dumps(line) + "\n")
+                self._tenant_windows[t.name] += len(lines)
+
+    def route_deltas(self, rows: list[dict]) -> None:
+        """Split drained delta rows by owning tenant: tenant-local cluster
+        renumbering, ack ledger, and the per-tenant deltas.jsonl stream."""
+        per: dict[str, list[dict]] = {t.name: [] for t in self.tenants}
+        for row in rows:
+            t = self.tenants[int(self._by_cluster[row["cluster"]])]
+            local = dict(row, cluster=row["cluster"] - t.lo)
+            t.delta_rows.append(local)
+            t.acked_values.extend(v for v in row["values"] if v != NOOP)
+            per[t.name].append(local)
+        if self._dir is not None:
+            for t in self.tenants:
+                if per[t.name]:
+                    deltas_mod.append_delta_rows(
+                        os.path.join(
+                            self._dir, "tenants", t.name, "deltas.jsonl"
+                        ),
+                        per[t.name],
+                    )
+
+    # ----------------------------------------------------------- stop logic
+
+    @property
+    def exhausted(self) -> bool:
+        """Every tenant's write source is dry AND every read demand met."""
+        return all(t.writes_done and t.reads_done for t in self.tenants)
+
+    @property
+    def offered(self) -> int:
+        return sum(t.offered for t in self.tenants)
+
+    @property
+    def reads_offered(self) -> int:
+        return sum(t.reads_offered for t in self.tenants)
+
+    @property
+    def reads_served(self) -> int:
+        return sum(t.reads_served for t in self.tenants)

@@ -135,8 +135,22 @@ def _add_gated(a, b):
     return a if _host_zero(b) else a + b
 
 
-def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
+def step_bad(info):
+    """The per-tick any-invariant-tripped predicate, shared by every
+    violations fold (metric accumulation, telemetry windows, the serve loop's
+    first-violation tick). viol_read_stale joins the classic three only when
+    its gate emitted a real array (cfg.read_lease AND check_invariants) --
+    the kernels emit a host-constant zero otherwise, and skipping the fold
+    (a HOST predicate, like _add_gated's) keeps disabled-mode programs
+    byte-identical."""
     bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
+    if not _host_zero(info.viol_read_stale):
+        bad = bad | info.viol_read_stale
+    return bad
+
+
+def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
+    bad = step_bad(info)
     has_leader = info.leader != NIL
     return RunMetrics(
         violations=m.violations + bad,
@@ -299,13 +313,27 @@ def tick_batch_minor(
             )
         )(keys, s.now, genome)
     if client_cmd is not None:
-        inp = inp._replace(client_cmd=jnp.full_like(inp.client_cmd, client_cmd))
+        # Scalar (one offer broadcast fleet-wide: Session.offer) or [B]
+        # (per-cluster offer plane: the tenancy serve loop, where the batch
+        # axis IS the tenancy axis and each cluster gets its tenant's own
+        # command this tick).
+        inp = inp._replace(
+            client_cmd=jnp.broadcast_to(
+                jnp.asarray(client_cmd, inp.client_cmd.dtype),
+                inp.client_cmd.shape,
+            )
+        )
     if read_cmd is not None:
         # External ReadIndex ingest (the read-only traffic class riding the
         # serve path beside offered writes): overrides the scheduled
-        # read cadence for this tick, exactly like client_cmd above. The
-        # config must carry the structural gate (cfg.read_index).
-        inp = inp._replace(read_cmd=jnp.full_like(inp.read_cmd, read_cmd))
+        # read cadence for this tick, exactly like client_cmd above --
+        # scalar or per-cluster [B]. The config must carry the structural
+        # gate (cfg.read_index).
+        inp = inp._replace(
+            read_cmd=jnp.broadcast_to(
+                jnp.asarray(read_cmd, inp.read_cmd.dtype), inp.read_cmd.shape
+            )
+        )
     inp_t = raft_batched.to_batch_minor(inp)
     s2, info = step_fn(cfg, s, inp_t)
     m2 = _accumulate(metrics, info, s.now)  # all fields [B]: elementwise

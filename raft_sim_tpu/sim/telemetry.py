@@ -107,6 +107,7 @@ def init_recorder(cfg: RaftConfig, k: int, batch: int) -> FlightRecorder:
         reads_served=leaf(jnp.int32),
         read_lat_sum=leaf(jnp.int32),
         read_hist=leaf(jnp.int32, LAT_HIST_BINS),
+        viol_read_stale=leaf(bool),
     )
     return FlightRecorder(
         ring=ring,
@@ -217,7 +218,7 @@ def run_batch_minor_telemetry(
             s2, wm2, info = scan.tick_batch_minor(
                 cfg, s, keys, wm, step_fn=step_fn, genome=genome, seg_len=seg_len
             )
-            bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
+            bad = scan.step_bad(info)
             fv2 = jnp.minimum(fv, jnp.where(bad, now, NEVER))
             rec2 = _record(rec, info, now, ring_k, bad) if ring_k else rec
             return (s2, wm2, fv2, rec2), None
@@ -258,7 +259,7 @@ def run_batch_minor_telemetry(
             cfg, s, keys, wm, step_fn=step_fn, genome=genome, seg_len=seg_len,
             events=True,
         )
-        bad = info.viol_election_safety | info.viol_commit | info.viol_log_matching
+        bad = scan.step_bad(info)
         fv2 = jnp.minimum(fv, jnp.where(bad, now, NEVER))
         trig = bad if trigger_kind is None else tev.any_of_kind(cfg, ev, trigger_kind)
         rec2 = _record(rec, info, now, ring_k, trig) if ring_k else rec

@@ -330,6 +330,17 @@ class ClusterState(NamedTuple):
     read_idx: jax.Array  # [N] int32: pending read's captured index + 1 (0 = none)
     read_tick: jax.Array  # [N] int32: offer stamp of the pending read
     read_acks: jax.Array  # [N, W] uint32: packed acks banked since capture
+    # Lease-read staleness anchor (cfg.read_lease; zeros and carried
+    # untouched otherwise -- thesis 6.4.1): the cluster's committed frontier
+    # (lat_frontier semantics: max commit any node ever reached) banked at
+    # the pending read's CAPTURE tick. A served read whose captured index
+    # falls below this frontier missed writes committed before it was issued
+    # -- the exact read_linearizability property the trace checker verifies,
+    # here as a per-tick device invariant (StepInfo.viol_read_stale) so the
+    # scenario hunt's fitness can see lease violations. Measurement state
+    # like lat_frontier, not node state: crash faults never touch it beyond
+    # the slot wipe it shares with read_idx.
+    read_fr: jax.Array  # [N] int32: frontier at the pending read's capture
     # Client-side state (cfg.client_redirect; NIL/0 otherwise): up to K =
     # cfg.client_pipeline commands the simulated client has in flight and the
     # node each one's next POST targets -- the array form of the reference
@@ -463,6 +474,15 @@ class StepInfo(NamedTuple):
     reads_served: jax.Array  # int32: ReadIndex reads served this tick
     read_lat_sum: jax.Array  # int32: summed offer->serve latency of served reads
     read_hist: jax.Array  # [LAT_HIST_BINS] int32 (zeros unless read_index)
+    # Lease-read staleness invariant (cfg.read_lease AND check_invariants;
+    # a host-constant zero otherwise, with the fold gated like the read
+    # metrics -- scan.step_bad): a read was SERVED whose captured index sits
+    # below the committed frontier banked at its capture (ClusterState.
+    # read_fr). Folds into RunMetrics.violations, so the scenario hunt's
+    # fitness sees lease violations the classic viol_* flags cannot -- the
+    # device-visible form of the checker's read_linearizability property.
+    # Defaulted so hand-built StepInfos predating the lease plane stay valid.
+    viol_read_stale: jax.Array = False  # bool: a stale lease read was served
 
 
 def empty_mailbox(cfg: RaftConfig) -> Mailbox:
@@ -540,6 +560,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         read_idx=jnp.zeros((n,), jnp.int32),
         read_tick=jnp.zeros((n,), jnp.int32),
         read_acks=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
+        read_fr=jnp.zeros((n,), jnp.int32),
         client_pend=jnp.full((cfg.client_pipeline,), NIL, jnp.int32),
         client_dst=jnp.zeros((cfg.client_pipeline,), jnp.int32),
         client_tick=jnp.zeros((cfg.client_pipeline,), jnp.int32),

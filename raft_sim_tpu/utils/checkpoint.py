@@ -89,7 +89,14 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      telemetry schema v3). All new leaves are zeros/NIL and loop-invariant
 #      unless their structural gate (reconfig_interval / transfer_interval /
 #      read_interval > 0) is on.
-_FORMAT_VERSION = 22
+# v23: lease-based reads (thesis 6.4.1; the tenancy plane's read tier) --
+#      ClusterState gained read_fr (the committed frontier banked at a
+#      pending read's capture, the staleness anchor the viol_read_stale
+#      device invariant compares served reads against). Zeros and
+#      loop-invariant unless cfg.read_lease (read_lease_ticks > 0). Mailbox
+#      and RunMetrics are unchanged (the staleness flag folds into the
+#      existing violations counter).
+_FORMAT_VERSION = 23
 
 # The single exported source of truth for the on-disk format version
 # (re-exported as raft_sim_tpu.CHECKPOINT_FORMAT_VERSION). Everything that
@@ -105,7 +112,7 @@ FORMAT_VERSION = _FORMAT_VERSION
 # refreshing this pin -- the convention the v2..v19 log always relied on,
 # now machine-checked. Refresh with:
 #     python -c "from raft_sim_tpu.analysis import policy; print(policy.schema_fingerprint())"
-_SCHEMA_FINGERPRINT = (22, "fb55c045173c093d")
+_SCHEMA_FINGERPRINT = (23, "0fdaffbacf9a1f5f")
 
 
 def _normalize(path: str) -> str:

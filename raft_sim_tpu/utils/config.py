@@ -183,6 +183,29 @@ class RaftConfig:
     # a read traffic class with its own latency histogram
     # (StepInfo.read_hist) beside the write path's commit latency.
     read_interval: int = 0
+    # Lease-based reads (thesis 6.4.1): with a nonzero lease term, a leader
+    # holding a fresh quorum of AppendEntries acknowledgments -- every member
+    # of a configuration majority acked within the last `read_lease_ticks`
+    # GLOBAL ticks (the ack_age plane) -- serves a pending read immediately,
+    # with NO confirmation round. Steady-state reads then cost zero quorum
+    # rounds. The safety argument (docs/PROTOCOL.md "Lease reads") leans on
+    # a clock assumption: voters deny RequestVote while they heard from a
+    # leader within the minimum election timeout ON THEIR LOCAL CLOCK
+    # (thesis 4.2.3 -- enabled by this gate), and local clocks may run up to
+    # 2x global time under clock skew, so the lease term must fit under
+    # HALF the minimum election timeout with slack for the election round
+    # trip: 2 * read_lease_ticks + 4 <= election_min_ticks (validated
+    # below). The TEST-ONLY `lease_skew_safe` mutant hook drops exactly that
+    # 2x factor -- the skewed-clock lease violation the scenario hunt must
+    # produce and the trace checker's read_linearizability must reject.
+    # Requires the ReadIndex plane (read_index) and the offer-tick plane
+    # (track_offer_ticks: the staleness invariant reads lat_frontier).
+    read_lease_ticks: int = 0
+    # Standing-fleet read ingest (raft_sim_tpu/serve): keep the ReadIndex
+    # plane compiled for EXTERNALLY offered reads (Session.offer_read, the
+    # serve loop's per-tenant read planes) even with read_interval == 0 --
+    # the read-side mirror of serve_ingest, and a structural gate like it.
+    serve_reads: bool = False
 
     # PreVote (Raft thesis 9.6; BEYOND the reference, which has neither
     # pre-vote nor leadership transfer -- SURVEY.md 2.3.12). When True, an
@@ -243,6 +266,50 @@ class RaftConfig:
         assert self.transfer_interval >= 0
         assert self.read_interval >= 0
         assert self.reconfig_interval == 0 or self.n_nodes >= 3
+        assert self.read_lease_ticks >= 0
+        if self.read_lease_ticks > 0:
+            # Lease reads ride the ReadIndex slot machinery and the staleness
+            # invariant reads the lat_frontier leg (track_offer_ticks).
+            assert self.read_index, (
+                "read_lease_ticks needs the ReadIndex plane: set a nonzero "
+                "read_interval or serve_reads"
+            )
+            assert self.track_offer_ticks, (
+                "read_lease_ticks needs the offer-tick plane (client_interval "
+                "> 0 or serve_ingest): the lease staleness invariant reads "
+                "the committed frontier leg"
+            )
+            # The skew-safe bound (docs/PROTOCOL.md "Lease reads"): voters
+            # deny votes for election_min_ticks of LOCAL clock after leader
+            # contact, local clocks advance at most 2 per global tick, and an
+            # election needs >= 2 more ticks to commit -- so the lease term
+            # must fit under half the denial window with that slack.
+            assert 2 * self.read_lease_ticks + 4 <= self.election_min_ticks, (
+                f"read_lease_ticks {self.read_lease_ticks} breaks the "
+                f"skew-safe bound 2*L+4 <= election_min_ticks "
+                f"({self.election_min_ticks})"
+            )
+            # The lease predicate compares against the SATURATING ack_age
+            # plane: any window at or past the ceiling would treat
+            # arbitrarily stale (saturated) acks as fresh and hold the lease
+            # forever. Bounded for the mutant's widened no-skew window
+            # (election_min + 2) too, so even the TEST-ONLY weakening can
+            # never alias into saturation.
+            assert self.election_min_ticks + 2 < self.ack_age_sat, (
+                f"lease windows (up to election_min_ticks + 2 = "
+                f"{self.election_min_ticks + 2}) must stay below the ack_age "
+                f"saturation ceiling ({self.ack_age_sat})"
+            )
+            # No transfer-override flag exists yet (thesis 3.10 pairs
+            # TimeoutNow with a disruptive-RequestVote flag that bypasses the
+            # lease denial); without it a transfer target's election would be
+            # denied by the very lease it is meant to inherit. Named
+            # follow-up in docs/PROTOCOL.md.
+            assert self.transfer_interval == 0, (
+                "read_lease_ticks and transfer_interval are mutually "
+                "exclusive until the lease-override RequestVote flag exists "
+                "(docs/PROTOCOL.md, lease reads follow-ups)"
+            )
 
     @property
     def track_offer_ticks(self) -> bool:
@@ -275,8 +342,18 @@ class RaftConfig:
     @property
     def read_index(self) -> bool:
         """True when the ReadIndex read traffic class is active (read slot
-        state, ack banking, and the read latency histogram compile)."""
-        return self.read_interval > 0
+        state, ack banking, and the read latency histogram compile): a
+        scheduled read cadence, or standing-fleet read ingest (serve_reads --
+        externally offered reads, the read-side serve_ingest)."""
+        return self.read_interval > 0 or self.serve_reads
+
+    @property
+    def read_lease(self) -> bool:
+        """True when lease-based reads are active (read_lease_ticks > 0):
+        the vote-denial rule compiles into RequestVote handling, the lease
+        predicate into read serving, and the read_fr frontier leg + the
+        viol_read_stale device invariant go live."""
+        return self.read_lease_ticks > 0
 
     # -- TEST-ONLY mutation hooks (scenario/mutation.py). Each extension's
     # correctness hinges on one rule; these properties are that rule as data,
@@ -301,6 +378,21 @@ class RaftConfig:
         """False (mutants only): a TimeoutNow target assumes leadership
         DIRECTLY (no vote round, no up-to-date check) and the leader fires
         without waiting for the target to catch up -- transfer as a coup."""
+        return True
+
+    @property
+    def lease_skew_safe(self) -> bool:
+        """False (mutants only): the lease window is judged as if local
+        clocks advanced exactly one unit per global tick -- the kernel
+        serves lease reads for election_min_ticks + 2 instead of the
+        configured skew-safe read_lease_ticks. Correct on unskewed clocks
+        (a deposing election needs a full election_min of vote-denial
+        expiry plus the vote and commit round trips, one tick more than
+        the widened lease);
+        under clock skew a fast follower's vote-denial window halves in
+        global time, a new leader commits inside the optimistic lease, and
+        the deposed leader serves a stale read -- the thesis-6.4.1 clock
+        assumption made falsifiable (the hunt drives the skew genome axis)."""
         return True
 
     @property
@@ -429,6 +521,30 @@ PRESETS: dict[str, tuple[RaftConfig, int]] = {
             reconfig_interval=97,
             transfer_interval=61,
             read_interval=7,
+        ),
+        1_000,
+    ),
+    # Lease-read acceptance preset (the tenancy plane's read tier): client
+    # writes + a dense scheduled read stream served through leases
+    # (read_lease_ticks = 4 against the widened election_min_ticks = 12 --
+    # the skew-safe bound 2*4+4 <= 12 exactly), under drop + clock skew so
+    # the lease's clock assumption is exercised, not idle. The trace checker
+    # must pass all six properties over its histories while the lease-skew
+    # mutant of the same preset is rejected naming read_linearizability
+    # (tests/test_lease.py, CI serve smoke).
+    "config9": (
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=64,
+            compact_margin=8,
+            max_entries_per_rpc=4,
+            election_min_ticks=12,
+            election_range_ticks=8,
+            client_interval=4,
+            read_interval=3,
+            read_lease_ticks=4,
+            drop_prob=0.05,
+            clock_skew_prob=0.1,
         ),
         1_000,
     ),

@@ -120,6 +120,57 @@ MANIFEST_FIELDS = (
 )
 
 
+def window_lines(records, first_index: int) -> list[dict]:
+    """Aggregate a stacked WindowRecord (public layout: leaves
+    [B, n_windows, ...]) into windows.jsonl line dicts, numbered from
+    `first_index`. THE one aggregation: the fleet sink and the per-tenant
+    streams (serve/tenancy.py slices the same records by cluster range) both
+    call it, so a tenant's windows.jsonl can never drift from the fleet
+    schema. Pure integer sums/mins/maxes -- `metrics_report` re-merges lines
+    losslessly."""
+    start = np.asarray(records.start)  # [B, n_windows] (lockstep: rows equal)
+    fv = np.asarray(records.first_viol_tick, dtype=np.int64)
+    m = {f: np.asarray(getattr(records.metrics, f)) for f in records.metrics._fields}
+    n_windows = start.shape[1]
+    lines = []
+    for w in range(n_windows):
+        viol = m["violations"][:, w]
+        fvw = int(fv[:, w].min())
+        lines.append({
+            "window": first_index + w,
+            "start": int(start[0, w]),
+            "ticks": int(m["ticks"][0, w]),
+            "violations": int(viol.sum()),
+            "violating_clusters": int((viol > 0).sum()),
+            "first_viol_tick": None if fvw == _NEVER else fvw,
+            "msgs": int(m["total_msgs"].astype(np.int64)[:, w].sum()),
+            "cmds": int(m["total_cmds"].astype(np.int64)[:, w].sum()),
+            "max_term": int(m["max_term"][:, w].max()),
+            "max_commit": int(m["max_commit"][:, w].max()),
+            "lat_sum": int(m["lat_sum"].astype(np.int64)[:, w].sum()),
+            "lat_cnt": int(m["lat_cnt"].astype(np.int64)[:, w].sum()),
+            "lat_excluded": int(m["lat_excluded"].astype(np.int64)[:, w].sum()),
+            "noop_blocked": int(m["noop_blocked"].astype(np.int64)[:, w].sum()),
+            "lm_skipped_pairs": int(
+                m["lm_skipped_pairs"].astype(np.int64)[:, w].sum()
+            ),
+            "multi_leader": int(
+                m["multi_leader"].astype(np.int64)[:, w].sum()
+            ),
+            "reads": int(m["reads_served"].astype(np.int64)[:, w].sum()),
+            "read_lat_sum": int(
+                m["read_lat_sum"].astype(np.int64)[:, w].sum()
+            ),
+            "lat_hist": [
+                int(x) for x in m["lat_hist"].astype(np.int64)[:, w].sum(axis=0)
+            ],
+            "read_hist": [
+                int(x) for x in m["read_hist"].astype(np.int64)[:, w].sum(axis=0)
+            ],
+        })
+    return lines
+
+
 def config_hash(cfg: RaftConfig) -> str:
     """Stable short hash of the full config (key-sorted JSON), the manifest's
     comparability key: two runs diff cleanly iff their hashes match."""
@@ -190,51 +241,12 @@ class TelemetrySink:
         [B, n_windows, ...]) and append one JSONL line per window. Returns the
         number of lines written. Aggregation is pure integer sums/mins/maxes,
         so `metrics_report` can re-merge lines losslessly."""
-        start = np.asarray(records.start)  # [B, n_windows] (lockstep: rows equal)
-        fv = np.asarray(records.first_viol_tick, dtype=np.int64)
-        m = {f: np.asarray(getattr(records.metrics, f)) for f in records.metrics._fields}
-        n_windows = start.shape[1]
-        lines = []
-        for w in range(n_windows):
-            viol = m["violations"][:, w]
-            fvw = int(fv[:, w].min())
-            lines.append({
-                "window": self._n_windows + w,
-                "start": int(start[0, w]),
-                "ticks": int(m["ticks"][0, w]),
-                "violations": int(viol.sum()),
-                "violating_clusters": int((viol > 0).sum()),
-                "first_viol_tick": None if fvw == _NEVER else fvw,
-                "msgs": int(m["total_msgs"].astype(np.int64)[:, w].sum()),
-                "cmds": int(m["total_cmds"].astype(np.int64)[:, w].sum()),
-                "max_term": int(m["max_term"][:, w].max()),
-                "max_commit": int(m["max_commit"][:, w].max()),
-                "lat_sum": int(m["lat_sum"].astype(np.int64)[:, w].sum()),
-                "lat_cnt": int(m["lat_cnt"].astype(np.int64)[:, w].sum()),
-                "lat_excluded": int(m["lat_excluded"].astype(np.int64)[:, w].sum()),
-                "noop_blocked": int(m["noop_blocked"].astype(np.int64)[:, w].sum()),
-                "lm_skipped_pairs": int(
-                    m["lm_skipped_pairs"].astype(np.int64)[:, w].sum()
-                ),
-                "multi_leader": int(
-                    m["multi_leader"].astype(np.int64)[:, w].sum()
-                ),
-                "reads": int(m["reads_served"].astype(np.int64)[:, w].sum()),
-                "read_lat_sum": int(
-                    m["read_lat_sum"].astype(np.int64)[:, w].sum()
-                ),
-                "lat_hist": [
-                    int(x) for x in m["lat_hist"].astype(np.int64)[:, w].sum(axis=0)
-                ],
-                "read_hist": [
-                    int(x) for x in m["read_hist"].astype(np.int64)[:, w].sum(axis=0)
-                ],
-            })
+        lines = window_lines(records, self._n_windows)
         with open(self._path("windows.jsonl"), "a") as f:
             for line in lines:
                 f.write(json.dumps(line) + "\n")
-        self._n_windows += n_windows
-        return n_windows
+        self._n_windows += len(lines)
+        return len(lines)
 
     def append_perf(self, rows: list[dict]) -> int:
         """Append per-chunk perf-attribution rows (obs/timer.py ChunkTimer)

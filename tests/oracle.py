@@ -127,6 +127,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     rcf = cfg.reconfig
     xfr = cfg.leader_transfer
     rdx = cfg.read_index
+    rdl = cfg.read_lease
     role = s["role"].copy()
     term = s["term"].copy()
     voted_for = s["voted_for"].copy()
@@ -154,6 +155,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     read_idx = s["read_idx"].copy()
     read_tick = s["read_tick"].copy()
     read_acks = np.asarray(s["read_acks"], bool).copy()
+    read_fr = s["read_fr"].copy()
 
     alive = np.asarray(inp["alive"], bool)
     restarted = np.asarray(inp["restarted"], bool)
@@ -171,8 +173,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             commit[d] = log_base[d]
             commit_chk[d] = base_chk[d]
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
-            if cfg.pre_vote:
-                # a restarted node remembers no leader contact
+            if cfg.pre_vote or rdl:
+                # a restarted node remembers no leader contact (pre-votes
+                # grantable; under the lease gate, real votes too)
                 heard_clock[d] = int(s["clock"][d]) - cfg.election_min_ticks
             if xfr:
                 xfer_to[d] = NIL  # pending transfers die with the process
@@ -180,6 +183,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 read_idx[d] = 0  # pending reads die with the process
                 read_tick[d] = 0
                 read_acks[d, :] = False
+                if rdl:
+                    read_fr[d] = 0  # the staleness anchor dies with the slot
 
     # Reconfiguration plane: the TICK-START configuration governs every
     # quorum test this tick (models/raft.py); phase 5.2 transitions apply
@@ -258,6 +263,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             )
             if up_to_date:
                 can.append(src)
+        if rdl:
+            # Lease vote denial (thesis 4.2.3; models/raft.py phase 2):
+            # a voter that heard from a current leader within the minimum
+            # election timeout on its LOCAL clock denies RequestVote.
+            clock_d = int(s["clock"][d]) + int(inp["skew"][d])
+            if clock_d - int(heard_clock[d]) < cfg.election_min_ticks:
+                can = []
         if not can:
             continue
         if voted_for[d] != NIL:
@@ -381,6 +393,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # are quiet (not leader, no valid AE within the minimum election timeout).
     pv_out = np.zeros((n, n), bool)
     pv_grant = np.zeros((n, n), bool)
+    if rdl and not cfg.pre_vote:
+        # heard_clock maintenance for the lease vote denial (the pre-vote
+        # branch below maintains it when both gates are on).
+        for d in range(n):
+            if has_ae[d]:
+                heard_clock[d] = int(s["clock"][d]) + int(inp["skew"][d])
     if cfg.pre_vote:
         for d in range(n):
             clock_pv = int(s["clock"][d]) + int(inp["skew"][d])
@@ -613,16 +631,34 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 acks_eff = read_acks[d].copy()
                 acks_eff[d] = True
                 confirmed = packed_quorum_row(acks_eff)
-                if (confirmed if cfg.read_confirm else True) and alive[d]:
+                served = (confirmed if cfg.read_confirm else True) and alive[d]
+                if rdl and not served and alive[d]:
+                    # Lease fast path (thesis 6.4.1; models/raft.py): a
+                    # fresh config quorum of AE acks serves with NO
+                    # confirmation round. The lease-skew mutant widens the
+                    # window to the no-skew bound.
+                    lease_w = (
+                        cfg.read_lease_ticks
+                        if cfg.lease_skew_safe
+                        else cfg.election_min_ticks + 2
+                    )
+                    fresh_row = np.asarray(ack_age[d] <= lease_w, bool).copy()
+                    fresh_row[d] = True
+                    served = packed_quorum_row(fresh_row)
+                if served:
                     # serve (the latency metric rides StepInfo, which the
                     # oracle does not produce; parity pins the slot clears)
                     read_idx[d] = 0
                     read_tick[d] = 0
                     read_acks[d, :] = False
+                    if rdl:
+                        read_fr[d] = 0
             elif pend0:
                 read_idx[d] = 0  # role loss / adoption cancels the read
                 read_tick[d] = 0
                 read_acks[d, :] = False
+                if rdl:
+                    read_fr[d] = 0
         if int(inp["read_cmd"]) != NIL:
             caps = []
             for d in range(n):
@@ -641,6 +677,11 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 read_idx[d] = int(commit[d]) + 1
                 read_tick[d] = int(s["now"]) + 1
                 read_acks[d, :] = False
+                if rdl:
+                    # Staleness anchor: the committed frontier at capture
+                    # (lat_frontier semantics -- models/raft.py phase 5).
+                    read_fr[d] = max(int(s["lat_frontier"]),
+                                     int(commit.max()))
 
     # ---- phase 5.5: log compaction (advance base toward commit when fewer than
     # compact_margin free ring slots remain; base_chk extends in the checksum pass)
@@ -955,6 +996,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "read_idx": read_idx,
         "read_tick": read_tick,
         "read_acks": read_acks,
+        "read_fr": read_fr,
         "client_pend": np.asarray(client_pend, np.int32),
         "client_dst": np.asarray(client_dst, np.int32),
         "client_tick": np.asarray(client_tick, np.int32),

@@ -165,10 +165,10 @@ def test_types_comments_parse_and_hold():
     # v22: +member_old/member_new/cfg_epoch/cfg_pend (joint-consensus
     # membership plane), +xfer_to (TimeoutNow), +read_idx/read_tick/read_acks
     # (ReadIndex slot)
-    assert len(specs["ClusterState"]) == 33
+    assert len(specs["ClusterState"]) == 34  # v23: +read_fr (lease anchor)
     assert len(specs["Mailbox"]) == 23  # v22: +xfer_tgt
     assert len(specs["StepInputs"]) == 11  # v22: +reconfig/transfer/read cmds
-    assert len(specs["StepInfo"]) == 19  # v22: +reads_served/read_lat_sum/read_hist
+    assert len(specs["StepInfo"]) == 20  # v23: +viol_read_stale
     assert ast_lint.check_dtype_comments() == []
 
 

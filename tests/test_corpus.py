@@ -12,7 +12,11 @@ Artifacts are deliberately SMALL (N=5, short horizons): replaying the corpus
 costs one tiny scan compile per artifact, so it can grow by dozens before
 threatening the tier-1 budget. Seed additions: the weak-quorum election-
 safety hit and the blind-transfer commit-invariant hit (the PR-10
-reconfiguration plane's coup mutant), both hunted, shrunk, and frozen here.
+reconfiguration plane's coup mutant), both hunted, shrunk, and frozen here;
+PR 11 adds the lease-skew read-staleness hit (a skewed-clock lease violation
+-- the shrink RETAINED clock skew and partitions, the clock assumption made
+load-bearing; tests/test_lease.py pins the real kernel clean on the same
+genome).
 """
 
 from __future__ import annotations
@@ -33,6 +37,7 @@ def test_corpus_is_seeded():
     names = {os.path.basename(p) for p in ARTIFACTS}
     assert "weak-quorum-n5.json" in names
     assert "blind-transfer-n5.json" in names
+    assert "lease-skew-n5.json" in names
 
 
 @pytest.mark.parametrize(

@@ -61,24 +61,27 @@ SNAPSHOT_PROGRAMS = (
 # 10 = the 8 pre-v22 presets' programs + config3p (the PreVote bench row:
 # pre_vote is a structural gate, so its program is a deliberate fork) +
 # config8 (the reconfiguration plane: membership/transfer/read legs live).
-PINNED_STEP_LOWERINGS = 10
-PINNED_SCAN_LOWERINGS = 10
-PINNED_SCENARIO_SCAN_LOWERINGS = 10
+# 11 adds config9 (lease-based reads: the lease serve predicate, vote
+# denial, and the read_fr staleness leg are structural).
+PINNED_STEP_LOWERINGS = 11
+PINNED_SCAN_LOWERINGS = 11
+PINNED_SCENARIO_SCAN_LOWERINGS = 11
 # The standing-fleet serve program (serve/loop.py simulate_serve): one program
 # per structurally distinct serve-mode config. Serve variants collapse the
 # scheduled cadence (client_interval -> 0), so presets differing ONLY in their
 # cadence share one serve program (config2's serve variant IS config3's) --
 # which is why this pin sits below the preset count. Command values are traced
 # data: a multi-chunk `driver serve` session compiles nothing after warmup.
-# (+ config3p / config8 serve variants: 7 -> 9.)
-PINNED_SERVE_SCAN_LOWERINGS = 9
+# (+ config3p / config8 serve variants: 7 -> 9; + config9's lease-read
+# serve variant: 10.)
+PINNED_SERVE_SCAN_LOWERINGS = 10
 # The protocol-trace program (telemetry windowed scan + event ring + coverage
 # legs, raft_sim_tpu/trace): at most one per preset -- these are "the pinned
 # trace variants" ISSUE 9's acceptance names: tracing adds ZERO step lowerings
 # (extraction is delta-based outside the kernels) and the coverage search's
 # generations all reuse one trace program (genomes are traced data; the
 # analyzer's trace fork pairs pin value-invariance).
-PINNED_TRACE_SCAN_LOWERINGS = 10  # + config3p/config8 trace variants
+PINNED_TRACE_SCAN_LOWERINGS = 11  # + config3p/config8/config9 trace variants
 
 
 def _pins():

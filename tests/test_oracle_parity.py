@@ -226,6 +226,57 @@ CONFIGS = [
         # crossed with BOTH other structural gates: TimeoutNow's pre-vote
         # bypass, masked pre-quorums, ring-log current-term read captures
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=2,
+            reconfig_interval=5,
+            transfer_interval=2,
+            drop_prob=0.25,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        15,
+        # Slow tier (tier-1 budget): the deterministic transfer-during-joint
+        # interaction is pinned step by step in tier-1
+        # (tests/test_reconfig.py::test_transfer_fires_and_elects_during_
+        # joint_phase), and the n5-reconfig-plane row keeps the
+        # transfer x membership machinery oracle-swept every tier-1 run;
+        # this row adds the denser randomized interleaving sweep.
+        marks=pytest.mark.slow,
+        id="n5-transfer-during-joint",  # PR 10's named follow-up: a dense
+        # transfer cadence (every 2 ticks) against a 5-tick membership
+        # cadence under churn keeps TimeoutNow transfers pending, firing,
+        # and received WHILE joint phases are open -- dual-quorum elections
+        # of transfer targets, transfer aborts at removed-leader stepdown,
+        # lease handoffs across epoch bumps (the deterministic interaction
+        # is pinned in tests/test_reconfig.py; this row sweeps it vs the
+        # oracle under randomized fault interleavings)
+    ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            election_min_ticks=12,
+            election_range_ticks=6,
+            client_interval=2,
+            read_interval=3,
+            read_lease_ticks=4,
+            drop_prob=0.2,
+            clock_skew_prob=0.3,
+            crash_prob=0.4,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        16,
+        id="n5-lease-reads",  # the ISSUE-11 lease plane vs the oracle under
+        # skew + drop + crash churn: the lease serve predicate over ack_age
+        # quorums, the thesis-4.2.3 vote denial on skewed local clocks, and
+        # the read_fr staleness anchor riding capture/serve/cancel/restart
+    ),
 ]
 
 

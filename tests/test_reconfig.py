@@ -219,6 +219,63 @@ def test_transfer_lease_blocks_writes_and_fires_timeout_now():
     assert int(s.mailbox.xfer_tgt[0]) == 3
 
 
+def test_transfer_fires_and_elects_during_joint_phase():
+    """PR 10's named follow-up, deterministic: a TimeoutNow transfer
+    accepted, fired, received, and COMPLETED while a membership change is
+    parked in its joint phase. The target's bypass election runs under the
+    DUAL quorum, the joint phase stays open throughout (the exit bound is
+    far), and the deposed old leader's pending transfer aborts on term
+    adoption -- the temporal interaction the randomized
+    n5-transfer-during-joint parity row sweeps, pinned step by step."""
+    from raft_sim_tpu.types import REQ_TIMEOUT_NOW, REQ_VOTE
+
+    n = 5
+    cfg = RaftConfig(
+        n_nodes=n, log_capacity=8, reconfig_interval=1000,
+        transfer_interval=1000, client_interval=4,
+    )
+    s = init_state(cfg, jax.random.key(0))
+    s = s._replace(
+        role=s.role.at[0].set(LEADER),
+        term=jnp.full((n,), 2, jnp.int32),
+        leader_id=jnp.zeros((n,), jnp.int32),
+        ack_age=jnp.zeros((n, n), s.ack_age.dtype),  # everyone responsive
+        deadline=s.deadline.at[0].set(1),  # heartbeat fires on tick 1
+        # Joint phase mid-flight: removing node 4, exit bound far away.
+        member_new=_mask(n, {0, 1, 2, 3}),
+        cfg_pend=jnp.int32(10),
+        cfg_epoch=jnp.int32(1),
+    )
+    step = jax.jit(lambda st, i: raft.step(cfg, st, i))
+    # Tick 1: transfer to node 1 accepted WHILE joint; the heartbeat slot
+    # carries the TimeoutNow (target trivially caught up: empty logs).
+    s, _ = step(s, _quiet_inputs(cfg, transfer_cmd=jnp.int32(1)))
+    assert int(s.xfer_to[0]) == 1 and int(s.cfg_pend) == 10
+    assert int(s.mailbox.req_type[0]) == REQ_TIMEOUT_NOW
+    assert int(s.mailbox.xfer_tgt[0]) == 1
+    # Tick 2: the target receives it at the current term and starts a REAL
+    # election immediately -- term bump, self-vote, RequestVote broadcast.
+    s, _ = step(s, _quiet_inputs(cfg))
+    assert int(s.role[1]) == CANDIDATE and int(s.term[1]) == 3
+    assert int(s.mailbox.req_type[1]) == REQ_VOTE
+    # Tick 3: voters adopt term 3 and grant; the deposed old leader's
+    # pending transfer aborts on adoption (volatile leader state).
+    s, _ = step(s, _quiet_inputs(cfg))
+    assert int(s.role[0]) == FOLLOWER and int(s.term[0]) == 3
+    assert int(s.xfer_to[0]) == NIL
+    # Tick 4: the target banks a DUAL quorum (majorities of C_old AND C_new
+    # -- all five granted here, covering both) and wins, with the joint
+    # phase still open: leadership moved INSIDE the membership change.
+    s, _ = step(s, _quiet_inputs(cfg))
+    assert int(s.role[1]) == LEADER
+    assert int(s.cfg_pend) == 10 and int(s.cfg_epoch) == 1
+    # One more quiet tick: no spurious joint exit (commit still below the
+    # bound) and exactly one leader.
+    s, info = step(s, _quiet_inputs(cfg))
+    assert int(s.cfg_pend) == 10
+    assert int(info.n_leaders) == 1 and not bool(info.viol_election_safety)
+
+
 def test_transfer_run_moves_leadership_without_violations():
     """A standing transfer cadence under light drop: leadership actually
     moves between nodes (TimeoutNow elections complete) and no safety

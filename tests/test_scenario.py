@@ -364,15 +364,15 @@ def test_checkpoint_v20_carries_scenario_and_gates_plain_resume(tmp_path):
     assert none_scen is None
 
 
-def test_checkpoint_v22_migration_error_names_versions(tmp_path):
-    """A v21 file (the pre-reconfiguration-plane format: no membership/
-    transfer/read leaves) errors with the migration hint -- the PR 3 hygiene
-    rule, applied to the v22 bump."""
+def test_checkpoint_v23_migration_error_names_versions(tmp_path):
+    """A v22 file (the pre-lease format: no read_fr staleness leg) errors
+    with the migration hint -- the PR 3 hygiene rule, applied to the v23
+    bump."""
     from raft_sim_tpu.sim.scan import init_metrics_batch
     from raft_sim_tpu.types import init_batch
 
-    assert checkpoint._FORMAT_VERSION == 22
-    assert checkpoint._SCHEMA_FINGERPRINT[0] == 22
+    assert checkpoint._FORMAT_VERSION == 23
+    assert checkpoint._SCHEMA_FINGERPRINT[0] == 23
     cfg = RaftConfig(n_nodes=2, log_capacity=4, max_entries_per_rpc=1)
     key = jax.random.key(0)
     path = checkpoint.save(
@@ -381,9 +381,9 @@ def test_checkpoint_v22_migration_error_names_versions(tmp_path):
     )
     with np.load(path) as z:
         arrays = {k: z[k] for k in z.files}
-    arrays["__version__"] = np.int32(21)
+    arrays["__version__"] = np.int32(22)
     np.savez_compressed(path, **arrays)
     with pytest.raises(ValueError) as ex:
         checkpoint.load(path)
     msg = str(ex.value)
-    assert "v21" in msg and "v22" in msg and "version log" in msg
+    assert "v22" in msg and "v23" in msg and "version log" in msg

@@ -72,12 +72,14 @@ ARBITRARY = [2**31 - 1, -(2**31), -1000, 10**9, -7, 123456789]
 OFFER_AT = 32  # first offer tick: leaders are long elected by then
 
 
-def _plane(values, start=OFFER_AT, ticks=T):
-    """[T] offer plane with `values` at consecutive ticks from `start` --
-    pack_chunk's contiguous packing, shifted to a post-election window."""
-    plane = np.full((ticks,), NIL, np.int32)
-    plane[start : start + len(values)] = pack_chunk(values, len(values))
-    return jnp.asarray(plane)
+def _plane(values, start=OFFER_AT, ticks=T, batch=BATCH):
+    """[T, B] offer plane with `values` at consecutive ticks from `start`,
+    broadcast across the batch (the pre-tenancy one-client-over-the-fleet
+    form) -- pack_chunk's contiguous packing, shifted to a post-election
+    window."""
+    col = np.full((ticks,), NIL, np.int32)
+    col[start : start + len(values)] = pack_chunk(values, len(values))
+    return jnp.asarray(np.broadcast_to(col[:, None], (ticks, batch)))
 
 
 def assert_equal_except_values(a, b):
@@ -197,7 +199,9 @@ def test_arbitrary_payload_parity_unbatched_kernel():
     k_init, k_run = jax.random.split(key)
 
     def drive(values):
-        plane = np.asarray(_plane(values, ticks=48))
+        # The unbatched kernel takes one scalar offer per tick: one column of
+        # the (broadcast) [T, B] plane.
+        plane = np.asarray(_plane(values, ticks=48, batch=1))[:, 0]
         s = init_state(SCFG, k_init)
         infos = []
         for t in range(48):
@@ -225,7 +229,8 @@ def test_scheduled_cadence_equals_explicit_plane():
     against the scheduled path in tests/test_scenario.py, closing the
     genome -> scheduled -> served chain)."""
     s_sched, m_sched = scan.simulate(BASE, 0, BATCH, T)
-    cmds = jnp.asarray(pack_chunk([t + 1 for t in range(T)], T))
+    col = pack_chunk([t + 1 for t in range(T)], T)
+    cmds = jnp.asarray(np.broadcast_to(col[:, None], (T, BATCH)))
     s_srv, m_srv, _ = simulate_serve(SCFG, 0, BATCH, cmds, WINDOW)
     assert_trees_equal(s_sched, s_srv, "scheduled vs explicit-plane state")
     assert_trees_equal(m_sched, m_srv, "scheduled vs explicit-plane metrics")

@@ -1,0 +1,254 @@
+"""The tenancy plane (ISSUE 11): partitioned multi-tenant fleets, the
+overlapped serve loop, and the read-ingest path.
+
+Acceptance surface pinned here:
+  - per-tenant command acks round-trip (every offered value lands in its
+    tenant's ack ledger) and per-tenant read demands are MET (served-read
+    credits from the telemetry windows reach each demand);
+  - per-tenant export streams validate (tenant-local deltas.jsonl density,
+    fleet-schema windows.jsonl lines, the tenants.json manifest);
+  - the overlap structure is a perf.jsonl FACT: every steady chunk's export
+    + packing ran inside the dispatch->sync host window (annotated
+    pack_s/export_s bounded by host_s), i.e. under device compute -- not in
+    the serial gap;
+  - one compiled program at every tenant count: a second session over the
+    same config with a different partition adds ZERO jit-cache entries;
+  - Session.offer_read (docs/SERVE.md's named follow-up) acks via the
+    served-read counters, symmetric to offer()'s delta-stream acks.
+
+Program budget: ONE serve chunk program (module fixture; the second-session
+test reuses it by construction -- that IS the assertion) plus offer_read's
+single-tick program and one small chunked run program.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_sim_tpu import RaftConfig
+from raft_sim_tpu.serve import ServeSession, Tenant, ingest, loop
+from raft_sim_tpu.serve import deltas as deltas_mod
+from raft_sim_tpu.serve.loop import serve_config
+from raft_sim_tpu.types import NIL
+from raft_sim_tpu.utils import telemetry_sink
+
+# A small lease-read serve tier: writes + leased reads, overlap-friendly
+# chunking. serve_config collapses both cadences into the external gates.
+# compact_margin matters beyond ring semantics: election wins then append
+# no-ops, so a READ-ONLY tenant's leaders satisfy the 6.4 current-term-commit
+# capture gate without any client traffic (config9 makes the same choice;
+# docs/SERVE.md "read-only tenants").
+TCFG = RaftConfig(
+    n_nodes=3,
+    log_capacity=32,
+    compact_margin=8,
+    election_min_ticks=12,
+    election_range_ticks=6,
+    client_interval=4,
+    read_interval=3,
+    read_lease_ticks=4,
+)
+TB, TCHUNK, TW = 6, 64, 32
+
+
+def test_pack_plane_tick_major_fill_and_validation():
+    p = ingest.pack_plane([1, 2, 3, 4, 5], 3, 2)
+    assert p.shape == (3, 2) and p.dtype == np.int32
+    assert p.tolist() == [[1, 2], [3, 4], [5, NIL]]
+    with pytest.raises(ValueError, match="fit"):
+        ingest.pack_plane(list(range(7)), 3, 2)
+    with pytest.raises(ValueError, match="sentinel"):
+        ingest.pack_plane([NIL], 2, 2)
+
+
+def test_tenant_router_partition_validation():
+    from raft_sim_tpu.serve.tenancy import TenantRouter
+
+    with pytest.raises(ValueError, match="sum to"):
+        TenantRouter([Tenant("a", 2), Tenant("b", 2)], 6, True)
+    with pytest.raises(ValueError, match="duplicate"):
+        TenantRouter([Tenant("a", 3), Tenant("a", 3)], 6, True)
+    with pytest.raises(ValueError, match="ReadIndex"):
+        TenantRouter([Tenant("a", 6, reads=5)], 6, False)
+    r = TenantRouter([Tenant("a", 2), Tenant("b", 4)], 6, True)
+    assert (r.tenants[0].lo, r.tenants[0].hi) == (0, 2)
+    assert (r.tenants[1].lo, r.tenants[1].hi) == (2, 6)
+
+
+@pytest.fixture(scope="module")
+def tenanted(tmp_path_factory):
+    """ONE multi-tenant serving session shared by the module: three tenants
+    (write-only, mixed, read-only) over a 6-cluster fleet, sink + perf
+    attached -- one compiled chunk program."""
+    from raft_sim_tpu.obs import ChunkTimer
+    from raft_sim_tpu.utils.telemetry_sink import TelemetrySink
+
+    sink_dir = str(tmp_path_factory.mktemp("tenant_sink"))
+    scfg = serve_config(TCFG)
+    sink = TelemetrySink(
+        sink_dir, scfg, seed=3, batch=TB, window=TW, ring=0, source="serve"
+    )
+    perf = ChunkTimer(label="serve", batch=TB, sink=sink)
+    tenants = [
+        Tenant("writer", 2, source=[101, 102, 103, 104, 2**31 - 1]),
+        Tenant("mixed", 2, source=[-201, -202], reads=24),
+        Tenant("reader", 2, reads=30),
+    ]
+    sess = ServeSession(
+        TCFG, batch=TB, seed=3, chunk=TCHUNK, window=TW, delta_depth=8,
+        sink=sink, warmup_ticks=TCHUNK, perf=perf, tenants=tenants,
+    )
+    cache_sizes = []
+
+    def progress(_st):
+        cache_sizes.append(loop._serve_chunk._cache_size())
+
+    stats = sess.serve(drain_chunks=3, progress=progress)
+    return {
+        "sess": sess, "stats": stats, "tenants": tenants,
+        "sink_dir": sink_dir, "cache_sizes": cache_sizes, "scfg": scfg,
+    }
+
+
+def test_every_command_and_read_acks_round_trip(tenanted):
+    """The CI smoke's core claim at test scale: every offered command comes
+    back through its OWN tenant's ack ledger (payloads bit-exact, no
+    cross-tenant leakage) and every read demand is served."""
+    writer, mixed, reader = tenanted["tenants"]
+    assert sorted(set(writer.acked_values)) == [101, 102, 103, 104, 2**31 - 1]
+    assert sorted(set(mixed.acked_values)) == [-202, -201]
+    assert reader.acked_values == []  # read-only: no write ever leaked in
+    for t in (mixed, reader):
+        assert t.reads_served >= t.reads, (t.name, t.reads_served, t.reads)
+    assert writer.reads_offered == 0  # no demand, no offers
+    assert tenanted["stats"]["violations"] == 0
+    # ops_done = client commands acked + reads served (leader no-ops ride
+    # the raw delta stream but never the throughput numerator): the serve
+    # metric is commands+reads, never ticks.
+    st = tenanted["stats"]
+    assert st["ops_done"] == st["commands_acked"] + st["reads_served"]
+    assert st["commands_acked"] == len(writer.acked_values) + len(
+        mixed.acked_values
+    )
+    assert st["commands_acked"] <= st["deltas_exported"]  # no-ops excluded
+    assert st["reads_served"] >= mixed.reads + reader.reads
+
+
+def test_per_tenant_streams_validate(tenanted):
+    sink_dir = tenanted["sink_dir"]
+    assert telemetry_sink.validate(sink_dir) == []
+    man = json.load(open(os.path.join(sink_dir, "tenants.json")))
+    assert set(man) == {"writer", "mixed", "reader"}
+    fleet_windows = sum(
+        1 for _ in open(os.path.join(sink_dir, "windows.jsonl"))
+    )
+    for t in tenanted["tenants"]:
+        d = os.path.join(sink_dir, "tenants", t.name)
+        assert deltas_mod.validate_deltas(os.path.join(d, "deltas.jsonl")) == []
+        rows = [json.loads(x) for x in open(os.path.join(d, "windows.jsonl"))]
+        assert len(rows) == fleet_windows  # same window axis as the fleet
+        assert [r["window"] for r in rows] == list(range(len(rows)))
+        assert man[t.name] == {
+            "lo": t.lo, "hi": t.hi, "offered": t.offered,
+            "acked": len(t.acked_values), "reads_offered": t.reads_offered,
+            "reads_served": t.reads_served,
+        }
+        # The credited serves are exactly the tenant's windows' read column.
+        assert sum(r["reads"] for r in rows) == t.reads_served
+        # Tenant-local delta rows stay inside the tenant's cluster range.
+        for row in t.delta_rows:
+            assert 0 <= row["cluster"] < t.clusters
+
+
+def test_overlap_structure_asserted_from_perf_jsonl(tenanted):
+    """ISSUE 11 acceptance: the perf stream shows host packing/drain-export
+    overlapped under device compute. Every steady row's annotated pack_s +
+    export_s fits inside host_s -- the dispatch->sync window, i.e. while the
+    chunk ran on device -- and real export work happened there (not in the
+    serial gap, where the pre-overlap loop did it)."""
+    rows = [
+        json.loads(x)
+        for x in open(os.path.join(tenanted["sink_dir"], "perf.jsonl"))
+    ]
+    steady = [r for r in rows if not r["warmup"]]
+    assert steady, rows
+    for r in steady:
+        assert "pack_s" in r and "export_s" in r, r
+        assert r["pack_s"] + r["export_s"] <= r["host_s"] + 1e-3, r
+    assert sum(r["export_s"] for r in steady) > 0  # real overlapped export
+    assert sum(r["pack_s"] for r in steady) >= 0
+    assert not rows[-1]["recompiled"]
+    # The live rollup and the file agree (the obs contract).
+    s = tenanted["sess"].perf.summary()
+    assert s["recompiled_after_warmup"] is False
+
+
+def test_jit_cache_flat_across_tenant_counts(tenanted):
+    """The batch axis IS the tenancy axis: re-partitioning the same fleet
+    (3 tenants -> 1) compiles NOTHING new -- the chunk program is blind to
+    the partition. (The fixture session already pinned flatness across its
+    own chunks.)"""
+    sizes = tenanted["cache_sizes"]
+    assert len(set(sizes)) == 1, f"serve chunk recompiled mid-session: {sizes}"
+    before = loop._serve_chunk._cache_size()
+    sess2 = ServeSession(
+        TCFG, batch=TB, seed=9, chunk=TCHUNK, window=TW, delta_depth=8,
+        warmup_ticks=TCHUNK,
+        tenants=[Tenant("solo", TB, source=[7, 8, 9], reads=6)],
+    )
+    sess2.serve(drain_chunks=2)
+    assert loop._serve_chunk._cache_size() == before, (
+        "a tenant-count change forked the serve chunk program"
+    )
+    assert sorted(set(sess2.router.tenants[0].acked_values)) == [7, 8, 9]
+
+
+def test_legacy_single_source_serve_still_broadcasts(tenanted):
+    """serve(source) without tenants keeps the pre-tenancy semantics: one
+    logical client, every command offered to (and acked by) EVERY cluster --
+    and rides the same compiled chunk program."""
+    from raft_sim_tpu.serve import CommandSource
+
+    before = loop._serve_chunk._cache_size()
+    sess = ServeSession(
+        TCFG, batch=TB, seed=11, chunk=TCHUNK, window=TW, delta_depth=8,
+        warmup_ticks=TCHUNK,
+    )
+    sess.serve(CommandSource([55, 66]), drain_chunks=2)
+    for c in range(TB):
+        acked = sess.acked_values(c)
+        assert 55 in acked and 66 in acked, (c, acked)
+    assert loop._serve_chunk._cache_size() == before
+
+
+def test_session_offer_read_acks_via_served_counter(tmp_path):
+    """Session.offer_read -- the read-side Session.offer closing docs/
+    SERVE.md's named follow-up. The ack is the served-read counter
+    advancing (reads produce no log entry, so the delta stream has nothing
+    to carry; the counter is the same per-cluster column the tenancy router
+    credits demands from). Under the lease config the serve lands within a
+    tick or two of capture -- no confirmation round."""
+    from raft_sim_tpu.driver import Session
+
+    sess = Session(TCFG, batch=4, seed=0)
+    sess.run(TCHUNK, chunk=TCHUNK)  # elect leaders
+    res = sess.offer_read(wait=12)
+    assert res["served"] == 4, res  # every cluster's read acked
+    assert res["captured"] >= 0
+    # Without the ReadIndex plane the verb refuses loudly.
+    plain = Session(RaftConfig(n_nodes=3, client_interval=4), batch=2, seed=0)
+    with pytest.raises(ValueError, match="ReadIndex"):
+        plain.offer_read()
+    # And (like offer) it refuses to punch holes into an armed trace stream.
+    import dataclasses
+
+    tcfg = dataclasses.replace(TCFG, track_trace=True)
+    traced = Session(tcfg, batch=2, seed=0)
+    traced.attach_telemetry(str(tmp_path / "t"), window=16, ring=0)
+    traced.attach_trace(depth=32)
+    with pytest.raises(RuntimeError, match="trace"):
+        traced.offer_read()

@@ -46,7 +46,10 @@ from raft_sim_tpu import init_batch
 from raft_sim_tpu.sim import chunked, scan, trace
 from raft_sim_tpu.utils.config import PRESETS, RaftConfig
 
-VIOL_FIELDS = ("viol_election_safety", "viol_commit", "viol_log_matching")
+VIOL_FIELDS = (
+    "viol_election_safety", "viol_commit", "viol_log_matching",
+    "viol_read_stale",
+)
 
 
 def shrink(
