@@ -749,6 +749,7 @@ def _scenario_search(args, ap) -> int:
         seed=args.seed if args.seed is not None else 0,
         fitness=args.fitness,
         trace_depth=args.trace_depth,
+        proposal=args.proposal,
     )
     try:
         with _profile_ctx(args.profile):
@@ -799,6 +800,59 @@ def _scenario_shrink(args, ap) -> int:
         "removed": art["removed"],
         "segments": art["segments"],
         "repro_cmd": f"python tools/repro.py --scenario {args.out}",
+    }))
+    return 0
+
+
+def _scenario_farm(args, ap) -> int:
+    """`scenario farm`: the fuzzing farm (raft_sim_tpu/farm) -- a portfolio
+    of fitness members hunted in parallel from ONE compiled program per
+    generation, coverage-guided mutation against a farm-wide seen set, and
+    the auto-corpus policy (shrink -> dedup -> provenance-stamp ->
+    checker-gate -> freeze). Ends in either a frozen hit or a pinned
+    negative result (out-dir/negative.json with coverage numbers)."""
+    from raft_sim_tpu.farm import FarmSpec, parse_portfolio, run_farm
+
+    cfg, _ = build_config(args)
+    mutant = args.mutant
+    if mutant:
+        from raft_sim_tpu.scenario.mutation import mutant_config
+
+        try:
+            cfg = mutant_config(mutant, cfg)
+        except ValueError as ex:
+            ap.error(str(ex))
+    try:
+        spec = FarmSpec(
+            portfolio=parse_portfolio(args.portfolio),
+            budget_gens=args.budget_gens,
+            population=args.population,
+            ticks=args.ticks,
+            window=args.window,
+            elite_frac=args.elite_frac,
+            seed=args.seed if args.seed is not None else 0,
+            trace_depth=args.trace_depth,
+            guided=not args.no_guided,
+            stop_on=args.stop_on,
+        )
+        with _profile_ctx(args.profile):
+            res = run_farm(
+                cfg, spec, mutant=mutant, out_dir=args.out_dir,
+                corpus_dir=args.corpus_dir, freeze=args.freeze,
+            )
+    except ValueError as ex:
+        ap.error(str(ex))
+    print(json.dumps({
+        "found": bool(res.hits),
+        "hits": res.manifest["hits"],
+        "frozen": res.manifest["frozen"],
+        "dedup_rejected": res.dedup_rejected,
+        "negative": res.negative,
+        "generations_run": res.manifest["generations_run"],
+        "evaluations": res.manifest["evaluations"],
+        "cov_bits_total": res.manifest["cov_bits_total"],
+        "manifest_hash": res.manifest["manifest_hash"],
+        "out_dir": args.out_dir,
     }))
     return 0
 
@@ -1155,6 +1209,13 @@ def main(argv=None) -> int:
     ssearch.add_argument("--trace-depth", type=int, default=32, metavar="R",
                          help="coverage mode's per-window event-buffer depth "
                               "(the bitmap needs no deep buffer; default 32)")
+    ssearch.add_argument("--proposal", choices=("gaussian", "coverage-guided"),
+                         default="gaussian",
+                         help="proposal mode: 'gaussian' = classic CE draws; "
+                              "'coverage-guided' = mutate the previous "
+                              "generation's novelty-lit parents (requires "
+                              "--fitness=coverage) -- coverage-guided "
+                              "MUTATION, not just coverage-as-fitness")
     ssearch.add_argument("--seed", type=int, default=None)
     ssearch.add_argument("--backend", default="auto", metavar="NAME")
     ssearch.add_argument("--out", metavar="FILE", default=None,
@@ -1165,6 +1226,63 @@ def main(argv=None) -> int:
                               "DIR (view with tensorboard/xprof); capture is "
                               "bit-exact vs no capture (tier-1 pinned)")
     _add_config_flags(ssearch)
+
+    sfarm = ssub.add_parser(
+        "farm",
+        help="the fuzzing farm: portfolio hunts, coverage-guided mutation, "
+             "and the self-growing safety corpus (raft_sim_tpu/farm; "
+             "docs/SCENARIOS.md 'Running the farm')",
+    )
+    sfarm.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    # build_config reads args.batch; the farm population IS the batch.
+    sfarm.add_argument("--batch", type=int, default=None, help=argparse.SUPPRESS)
+    sfarm.add_argument("--mutant", default=None, metavar="NAME",
+                       help="TEST-ONLY: hunt a deliberately weakened kernel "
+                            "(scenario/mutation.py registry)")
+    sfarm.add_argument("--portfolio", default="scalar,coverage",
+                       metavar="M1,M2,...",
+                       help="comma list of fitness members hunted in "
+                            "parallel over disjoint slices of the fleet "
+                            "(farm/portfolio.py registry: scalar, coverage, "
+                            "multi_leader, commit_stall, read_staleness; "
+                            "default scalar,coverage)")
+    sfarm.add_argument("--budget-gens", type=int, default=8,
+                       help="generation budget; exhausting it hitless pins "
+                            "a negative result (out-dir/negative.json)")
+    sfarm.add_argument("--population", type=int, default=64,
+                       help="TOTAL fleet batch, split among the members")
+    sfarm.add_argument("--ticks", type=int, default=512)
+    sfarm.add_argument("--window", type=int, default=64,
+                       help="telemetry window (fitness resolution)")
+    sfarm.add_argument("--elite-frac", type=float, default=0.25)
+    sfarm.add_argument("--trace-depth", type=int, default=32, metavar="R")
+    sfarm.add_argument("--no-guided", action="store_true",
+                       help="disable coverage-guided mutation (pure "
+                            "per-member CE; a trace-free portfolio then "
+                            "runs the untraced program)")
+    sfarm.add_argument("--stop-on", choices=("hit", "frozen", "budget"),
+                       default="hit",
+                       help="early-stop policy: first processed hit "
+                            "(default), first NEWLY FROZEN artifact "
+                            "(dedup-rejected re-finds keep hunting), or "
+                            "never (run the whole budget)")
+    sfarm.add_argument("--seed", type=int, default=None)
+    sfarm.add_argument("--out-dir", metavar="DIR", required=True,
+                       help="farm output: farm_manifest.json, "
+                            "members/<name>/hunt.jsonl, perf.jsonl, "
+                            "negative.json on a hitless budget")
+    sfarm.add_argument("--corpus-dir", metavar="DIR", default=None,
+                       help="arm the auto-corpus policy against DIR "
+                            "(hits are shrunk + dedup'd by (kernel, kinds, "
+                            "mechanism-set) signature; e.g. tests/corpus)")
+    sfarm.add_argument("--freeze", action="store_true",
+                       help="let the farm WRITE new checker-gated, "
+                            "provenance-stamped artifacts into --corpus-dir")
+    sfarm.add_argument("--backend", default="auto", metavar="NAME")
+    sfarm.add_argument("--profile", metavar="DIR", default=None,
+                       help="capture a jax.profiler trace of the farm into "
+                            "DIR (view with tensorboard/xprof)")
+    _add_config_flags(sfarm)
 
     sshrink = ssub.add_parser(
         "shrink", help="minimize a search hit to a repro artifact"
@@ -1184,6 +1302,7 @@ def main(argv=None) -> int:
         return {
             "run": _scenario_run,
             "search": _scenario_search,
+            "farm": _scenario_farm,
             "shrink": _scenario_shrink,
         }[args.scmd](args, ap)
 

@@ -1,0 +1,19 @@
+"""raft_sim_tpu.farm: the fuzzing farm (tenth subsystem).
+
+Portfolio hunts (many fitness functions, one compiled program per
+generation), coverage-guided mutation against a farm-wide seen set, and the
+self-growing checker-gated safety corpus. See farm/core.py for the loop,
+farm/portfolio.py for the members, farm/corpus.py for the freeze policy,
+and docs/SCENARIOS.md "Running the farm" for the workflow.
+"""
+
+from raft_sim_tpu.farm.core import (  # noqa: F401
+    FARM_MANIFEST_SCHEMA,
+    FARM_NEGATIVE_SCHEMA,
+    FarmResult,
+    FarmSpec,
+    manifest_hash,
+    run_farm,
+    validate_farm_dir,
+)
+from raft_sim_tpu.farm.portfolio import FITNESS, parse_portfolio  # noqa: F401

@@ -1,0 +1,599 @@
+"""The fuzzing farm: portfolio hunts from one compiled program.
+
+`scenario search` is one CE loop with one fitness function; the farm is the
+orchestration layer that makes the fleet's scale count (ROADMAP item 5):
+
+  1. PORTFOLIO -- the batch axis is partitioned among fitness members
+     (farm/portfolio.py) the way serve/tenancy.py partitions tenants: each
+     member owns a contiguous cluster slice and its own CE distribution, and
+     one generation = ONE `telemetry.simulate_windowed` call for the whole
+     portfolio (genome rows are traced data; the compiled program never sees
+     the partition, so the jit cache is pinned flat across member counts).
+  2. COVERAGE-GUIDED MUTATION -- members propose through
+     `search.propose_coverage_guided` against a FARM-WIDE seen-bit union:
+     genomes that lit unseen (role x kind)/(kind -> kind) transition bits
+     anywhere in the portfolio become mutation parents everywhere,
+     deterministic per (genome, seed).
+  3. AUTO-CORPUS -- hits are shrunk (scenario/shrink.py; bounded: the
+     first violating cluster per member per generation, the rest counted
+     in the hunt stream), deduped against the existing corpus by (kernel,
+     violation-kinds, mechanism-set) signature, provenance-stamped,
+     checker-gated, and frozen into tests/corpus/ by the farm itself
+     (farm/corpus.py). A
+     budget exhausted without a hit ends in a PINNED NEGATIVE RESULT with
+     coverage numbers (negative.json) -- "we hunted this space to N
+     generations and lit B bits" is an artifact, not a shrug.
+
+Driver: `python -m raft_sim_tpu scenario farm` (docs/SCENARIOS.md "Running
+the farm"). Out-dir streams: farm_manifest.json, members/<name>/hunt.jsonl
+(one row per generation per member), negative.json (hitless budgets), and
+perf.jsonl (PR 8 ChunkTimer rows, one per generation -- the sink's perf
+schema, so `tools/metrics_report.py --perf` renders a farm like any loop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from raft_sim_tpu.farm import corpus as corpus_mod
+from raft_sim_tpu.farm import portfolio as portfolio_mod
+from raft_sim_tpu.scenario import genome as genome_mod
+from raft_sim_tpu.scenario import search as search_mod
+from raft_sim_tpu.scenario import shrink as shrink_mod
+from raft_sim_tpu.serve.tenancy import split_even
+from raft_sim_tpu.sim import telemetry
+from raft_sim_tpu.utils.config import RaftConfig
+
+FARM_MANIFEST_SCHEMA = "farm-manifest-v1"
+FARM_NEGATIVE_SCHEMA = "farm-negative-v1"
+
+# Required integer fields of a members/<name>/hunt.jsonl row
+# (validate_farm_dir; floats carry the fitness statistics).
+HUNT_INT_FIELDS = ("gen", "seed", "violating_clusters")
+HUNT_FLOAT_FIELDS = ("best_fitness", "mean_fitness")
+
+
+@dataclasses.dataclass(frozen=True)
+class FarmSpec:
+    """Farm hyperparameters. `population` is the TOTAL fleet batch, split
+    contiguously among the portfolio members (tenancy's split_even policy);
+    every member's sub-population shares the one compiled program."""
+
+    portfolio: tuple[str, ...] = ("scalar", "coverage")
+    budget_gens: int = 8
+    population: int = 64
+    ticks: int = 512
+    window: int = 64
+    elite_frac: float = 0.25
+    seed: int = 0
+    init_sigma: float = 0.35
+    min_sigma: float = 0.05
+    smoothing: float = 0.6
+    carry_best: bool = True
+    trace_depth: int = 32
+    # Coverage-guided mutation (search.propose_coverage_guided) for every
+    # member, against the farm-wide seen set. Forces the trace-variant
+    # program even for scalar-only portfolios (the novelty signal needs the
+    # bitmap); False + a trace-free portfolio runs untraced.
+    guided: bool = True
+    guided_frac: float = 0.5
+    # When to stop early: "hit" = first processed hit (found + shrunk +
+    # dedup'd), "frozen" = only a NEWLY FROZEN artifact stops the hunt
+    # (dedup-rejected re-finds keep hunting), "budget" = never early.
+    stop_on: str = "hit"
+    knobs: tuple = None  # None -> search.default_knobs(cfg)
+
+    def __post_init__(self):
+        if self.stop_on not in ("hit", "frozen", "budget"):
+            raise ValueError(
+                f"stop_on {self.stop_on!r} (have: hit, frozen, budget)"
+            )
+        if self.ticks % self.window:
+            raise ValueError(
+                f"ticks {self.ticks} must divide by window {self.window}"
+            )
+
+
+@dataclasses.dataclass
+class FarmResult:
+    """One farm run's outcome: the manifest dict (what farm_manifest.json
+    holds), the per-generation member rows, processed hits, frozen artifact
+    paths, and the dedup ledger."""
+
+    manifest: dict
+    generations: list[dict]
+    hits: list[dict]
+    frozen: list[str]
+    dedup_rejected: list[dict]
+
+    @property
+    def negative(self) -> bool:
+        return not self.hits
+
+
+def _nondefault_config(cfg: RaftConfig) -> dict:
+    return {
+        f.name: getattr(cfg, f.name)
+        for f in dataclasses.fields(RaftConfig)
+        if getattr(cfg, f.name) != f.default
+    }
+
+
+def manifest_hash(identity: dict) -> str:
+    """Stable short hash of the farm's identity (config, mutant, portfolio,
+    budget, seed): the provenance key tying a frozen artifact back to the
+    exact hunt that produced it."""
+    blob = json.dumps(identity, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class FarmSink:
+    """Writer half of the farm's out-dir schema (module docstring). Creating
+    one truncates the streams, telemetry-sink style; it also speaks the
+    ChunkTimer sink protocol (append_perf), so the PR 8 timer streams
+    perf.jsonl rows here directly."""
+
+    def __init__(self, directory: str, members: list[dict]):
+        import shutil
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        for stale in ("farm_manifest.json", "negative.json", "perf.jsonl"):
+            p = os.path.join(directory, stale)
+            if os.path.exists(p):
+                os.remove(p)
+        # A reused out-dir must not keep a previous run's member streams: an
+        # orphan members/<old-name>/hunt.jsonl would read as this run's data.
+        keep = {m["name"] for m in members}
+        mdir = os.path.join(directory, "members")
+        if os.path.isdir(mdir):
+            for name in os.listdir(mdir):
+                if name not in keep:
+                    shutil.rmtree(os.path.join(mdir, name))
+        self._hunt_paths = {}
+        for m in members:
+            d = os.path.join(mdir, m["name"])
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, "hunt.jsonl")
+            open(path, "w").close()
+            self._hunt_paths[m["name"]] = path
+
+    def append_hunt(self, member: str, row: dict) -> None:
+        with open(self._hunt_paths[member], "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def append_perf(self, rows: list[dict]) -> int:
+        with open(os.path.join(self.directory, "perf.jsonl"), "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        return len(rows)
+
+    def write_manifest(self, manifest: dict) -> str:
+        path = os.path.join(self.directory, "farm_manifest.json")
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+
+    def write_negative(self, doc: dict) -> str:
+        path = os.path.join(self.directory, "negative.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return path
+
+
+@dataclasses.dataclass
+class _Member:
+    """One portfolio member's host-side hunt state."""
+
+    name: str
+    fitness: str
+    lo: int
+    hi: int
+    mu: np.ndarray
+    sigma: np.ndarray
+    rng: np.random.Generator
+    best_x: np.ndarray | None = None
+    best_fit: float = -np.inf
+    prev_xs: np.ndarray | None = None
+    prev_novelty: np.ndarray | None = None
+
+    @property
+    def b(self) -> int:
+        return self.hi - self.lo
+
+
+def _member_names(portfolio: tuple[str, ...]) -> list[str]:
+    """Unique stream names for possibly-duplicated members (scalar, scalar2)."""
+    seen: dict[str, int] = {}
+    names = []
+    for f in portfolio:
+        seen[f] = seen.get(f, 0) + 1
+        names.append(f if seen[f] == 1 else f"{f}{seen[f]}")
+    return names
+
+
+def run_farm(
+    cfg: RaftConfig,
+    spec: FarmSpec | None = None,
+    mutant: str | None = None,
+    out_dir: str | None = None,
+    corpus_dir: str | None = None,
+    freeze: bool = False,
+    perf=None,
+) -> FarmResult:
+    """Run the portfolio hunt. `cfg` must already be the kernel under test
+    (mutant_config-applied for mutant hunts; `mutant` labels artifacts and
+    provenance, exactly like shrink). `corpus_dir` arms the auto-corpus
+    policy: hits are shrunk and dedup'd against it, and `freeze=True` lets
+    the farm write NEW artifacts into it (checker-gated). `perf` is an
+    obs.ChunkTimer; with an `out_dir` and no timer, the farm makes its own
+    and streams perf.jsonl there.
+
+    Hit processing is BOUNDED, not exhaustive: each generation, each
+    member's FIRST violating cluster is shrunk (one ablation ladder per
+    member-generation); the remaining violating clusters are counted in the
+    hunt rows and the manifest's violating_clusters_total -- a reported
+    number, never a silence. Under stop_on="budget" a reliably-broken
+    kernel therefore re-pays one shrink per member per generation only to
+    be dedup-rejected again; the default stop_on="hit" avoids that, and a
+    per-run signature memo is the named follow-up if long mutant soaks
+    become a workflow."""
+    spec = spec or FarmSpec()
+    portfolio = portfolio_mod.parse_portfolio(spec.portfolio)
+    knobs = spec.knobs or search_mod.default_knobs(cfg)
+    dim = len(knobs)
+    needs_trace = spec.guided or any(
+        portfolio_mod.FITNESS[f][1] for f in portfolio
+    )
+    run_cfg = cfg
+    trace_spec = None
+    seen = None
+    if needs_trace:
+        from raft_sim_tpu.trace.ring import COV_WORDS, TraceSpec
+
+        run_cfg = dataclasses.replace(cfg, track_trace=True)
+        trace_spec = TraceSpec(depth=spec.trace_depth, coverage=True)
+        seen = np.zeros(COV_WORDS, np.uint32)
+
+    sizes = split_even(spec.population, len(portfolio))
+    names = _member_names(portfolio)
+    members: list[_Member] = []
+    lo = 0
+    for i, (fname, b) in enumerate(zip(portfolio, sizes)):
+        members.append(_Member(
+            name=names[i], fitness=fname, lo=lo, hi=lo + b,
+            mu=np.full(dim, 0.5), sigma=np.full(dim, spec.init_sigma),
+            rng=np.random.default_rng([spec.seed, i]),
+        ))
+        lo += b
+
+    identity = {
+        "config": _nondefault_config(cfg),
+        "mutant": mutant,
+        "portfolio": list(portfolio),
+        "population": spec.population,
+        "ticks": spec.ticks,
+        "window": spec.window,
+        "budget_gens": spec.budget_gens,
+        "seed": spec.seed,
+        "guided": spec.guided,
+        # The CE knobs change the hunt's trajectory, so they are part of
+        # the hashed identity -- two hunts differing only in elite_frac
+        # must not share a provenance key.
+        "spec": {
+            "elite_frac": spec.elite_frac,
+            "smoothing": spec.smoothing,
+            "init_sigma": spec.init_sigma,
+            "min_sigma": spec.min_sigma,
+            "guided_frac": spec.guided_frac,
+            "trace_depth": spec.trace_depth,
+            "stop_on": spec.stop_on,
+        },
+    }
+    mhash = manifest_hash(identity)
+    member_docs = [
+        {"name": m.name, "fitness": m.fitness, "lo": m.lo, "hi": m.hi}
+        for m in members
+    ]
+    sink = FarmSink(out_dir, member_docs) if out_dir else None
+    if sink is not None and perf is None:
+        from raft_sim_tpu.obs import ChunkTimer
+
+        perf = ChunkTimer(label="farm", batch=spec.population, sink=sink)
+    if perf is not None:
+        perf.add_probe("telemetry.simulate_windowed", telemetry.simulate_windowed)
+
+    gens: list[dict] = []
+    hits: list[dict] = []
+    frozen: list[str] = []
+    dedup_rejected: list[dict] = []
+    cov_by_gen: list[int] = []
+    n_elite_of = lambda b: max(2, int(round(spec.elite_frac * b)))
+    stop = False
+
+    for gen in range(spec.budget_gens):
+        # --- propose: per-member CE draws, coverage-guided when armed.
+        xs = np.zeros((spec.population, dim))
+        for m in members:
+            if spec.guided:
+                mx = search_mod.propose_coverage_guided(
+                    m.rng, m.mu, m.sigma, m.b, m.prev_xs, m.prev_novelty,
+                    spec.seed, frac=spec.guided_frac,
+                )
+            else:
+                mx = search_mod.propose_gaussian(m.rng, m.mu, m.sigma, m.b)
+            if spec.carry_best and m.best_x is not None:
+                mx[0] = m.best_x
+            xs[m.lo:m.hi] = mx
+        rows = [search_mod.decode_row(cfg, knobs, x) for x in xs]
+        g = genome_mod.stack_rows(rows)
+        genome_mod.validate(cfg, g)
+        sim_seed = spec.seed + search_mod.SEED_STRIDE * gen
+
+        # --- evaluate: the WHOLE portfolio in one device call.
+        if perf is not None:
+            perf.begin(spec.ticks)
+        if trace_spec is None:
+            _, metrics, records, _ = telemetry.simulate_windowed(
+                run_cfg, sim_seed, spec.population, spec.ticks, spec.window,
+                genome=g,
+            )
+            tp = None
+        else:
+            _, metrics, records, _, _, tp = telemetry.simulate_windowed(
+                run_cfg, sim_seed, spec.population, spec.ticks, spec.window,
+                genome=g, trace=trace_spec,
+            )
+        import jax
+
+        if perf is not None:
+            perf.dispatched()
+            perf.end(sync=lambda: np.asarray(metrics.ticks))
+        metrics = jax.device_get(metrics)
+        records = jax.device_get(records)
+        cov = np.asarray(tp.cov) if tp is not None else None
+
+        # --- score + CE-update each member against the shared baseline.
+        viol_all = np.asarray(metrics.violations)
+        gen_rows = []
+        for m in members:
+            take = lambda x: jax.tree.map(lambda v: np.asarray(v)[m.lo:m.hi], x)
+            m_rec, m_met = take(records), take(metrics)
+            novelty = None
+            if cov is not None:
+                novelty = search_mod.coverage_novelty(cov[:, m.lo:m.hi], seen)
+            fit = portfolio_mod.FITNESS[m.fitness][0](m_rec, m_met, novelty)
+            order = np.argsort(-fit)
+            elites = xs[m.lo:m.hi][order[:n_elite_of(m.b)]]
+            a = spec.smoothing
+            m.mu = a * elites.mean(axis=0) + (1 - a) * m.mu
+            m.sigma = np.maximum(
+                a * elites.std(axis=0) + (1 - a) * m.sigma, spec.min_sigma
+            )
+            if fit[order[0]] > m.best_fit:
+                m.best_fit = float(fit[order[0]])
+                m.best_x = xs[m.lo + order[0]].copy()
+            m.prev_xs, m.prev_novelty = xs[m.lo:m.hi], novelty
+            row = {
+                "gen": gen,
+                "seed": int(sim_seed),
+                "member": m.name,
+                "fitness": m.fitness,
+                "best_fitness": float(fit[order[0]]),
+                "mean_fitness": float(fit.mean()),
+                "violating_clusters": int((viol_all[m.lo:m.hi] > 0).sum()),
+                "novelty_bits": (
+                    int(novelty.sum()) if novelty is not None else None
+                ),
+                "best_genome": genome_mod.decode(rows[m.lo + order[0]])[0],
+            }
+            gen_rows.append(row)
+        # Union AFTER every member scored: scoring is member-order-free and
+        # the seen set grows monotonically (tests/test_farm.py pins both).
+        if cov is not None:
+            seen = search_mod.seen_union(cov, seen)
+            total_bits = int(search_mod._popcount_words(seen[:, None])[0])
+            for row in gen_rows:
+                row["cov_total_bits"] = total_bits
+            cov_by_gen.append(total_bits)
+        if sink is not None:
+            for row in gen_rows:
+                sink.append_hunt(row["member"], row)
+        gens.extend(gen_rows)
+
+        # --- bank hits: first violating cluster per member this generation.
+        for m in members:
+            violating = np.flatnonzero(viol_all[m.lo:m.hi] > 0)
+            if not violating.size:
+                continue
+            c = m.lo + int(violating[0])
+            fv = np.asarray(records.first_viol_tick)[c]
+            hit = {
+                "seed": int(sim_seed),
+                "batch": int(spec.population),
+                "cluster": c,
+                "ticks": int(spec.ticks),
+                "seg_len": 1,
+                "first_viol_tick": int(fv[fv < telemetry.NEVER].min()),
+                "genome_raw": genome_mod.to_raw(rows[c]),
+                "segments": genome_mod.decode(rows[c]),
+                "member": m.name,
+                "fitness": m.fitness,
+                "gen": gen,
+            }
+            hits.append(hit)
+            if corpus_dir is not None:
+                art = shrink_mod.shrink(cfg, hit, mutant=mutant)
+                dup = corpus_mod.find_duplicate(art, corpus_dir)
+                if dup is not None:
+                    dedup_rejected.append(dict(dup, member=m.name, gen=gen))
+                elif freeze:
+                    path, _ = corpus_mod.freeze(
+                        art, corpus_dir,
+                        provenance={
+                            "mutant": mutant,
+                            "fitness": m.fitness,
+                            "member": m.name,
+                            "generation": gen,
+                            "seed": int(sim_seed),
+                            "farm": mhash,
+                        },
+                    )
+                    frozen.append(path)
+                    if spec.stop_on == "frozen":
+                        stop = True
+                else:
+                    hit["unfrozen"] = True  # new signature, freezing off
+            if spec.stop_on == "hit":
+                stop = True
+        if stop:
+            break
+
+    manifest = {
+        "schema": FARM_MANIFEST_SCHEMA,
+        **identity,
+        "manifest_hash": mhash,
+        "members": member_docs,
+        "generations_run": (gens[-1]["gen"] + 1) if gens else 0,
+        "evaluations": ((gens[-1]["gen"] + 1) if gens else 0) * spec.population,
+        # Hit processing is BOUNDED (one shrink ladder per member per
+        # generation: the first violating cluster); the full violating-
+        # cluster count is reported here and per generation in the hunt
+        # rows, so unprocessed hits are a number, never a silence.
+        "violating_clusters_total": sum(
+            g["violating_clusters"] for g in gens
+        ),
+        "cov_bits_total": cov_by_gen[-1] if cov_by_gen else None,
+        "hits": [
+            {k: h[k] for k in ("member", "fitness", "gen", "seed", "cluster",
+                               "first_viol_tick")}
+            for h in hits
+        ],
+        "frozen": [os.path.basename(p) for p in frozen],
+        "dedup_rejected": dedup_rejected,
+        "negative": not hits,
+    }
+    if sink is not None:
+        sink.write_manifest(manifest)
+        if not hits:
+            sink.write_negative({
+                "schema": FARM_NEGATIVE_SCHEMA,
+                "manifest_hash": mhash,
+                **identity,
+                "generations": manifest["generations_run"],
+                "evaluations": manifest["evaluations"],
+                "cov_bits_total": manifest["cov_bits_total"],
+                "cov_bits_by_gen": cov_by_gen,
+                "knobs": [dataclasses.asdict(k) for k in knobs],
+            })
+    return FarmResult(
+        manifest=manifest, generations=gens, hits=hits, frozen=frozen,
+        dedup_rejected=dedup_rejected,
+    )
+
+
+def validate_farm_dir(directory: str) -> list[str]:
+    """Schema-check a farm out-dir ([] = valid): manifest fields, per-member
+    hunt.jsonl rows with contiguous generations, the negative artifact when
+    the manifest claims one, and perf.jsonl rows against the telemetry
+    sink's perf field tuples (one shared perf schema repo-wide)."""
+    errors = []
+    man_path = os.path.join(directory, "farm_manifest.json")
+    if not os.path.isfile(man_path):
+        return [f"missing farm_manifest.json in {directory}"]
+    try:
+        with open(man_path) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError) as ex:
+        return [f"farm_manifest.json unreadable: {ex}"]
+    for k in ("schema", "config", "portfolio", "members", "manifest_hash",
+              "population", "budget_gens", "seed", "generations_run",
+              "hits", "frozen", "dedup_rejected", "negative"):
+        if k not in man:
+            errors.append(f"farm_manifest.json: missing field {k!r}")
+    if man.get("schema") != FARM_MANIFEST_SCHEMA:
+        errors.append(
+            f"farm_manifest.json: schema {man.get('schema')!r}, expected "
+            f"{FARM_MANIFEST_SCHEMA}"
+        )
+    for m in man.get("members", []):
+        path = os.path.join(directory, "members", m.get("name", "?"), "hunt.jsonl")
+        if not os.path.isfile(path):
+            errors.append(f"missing members/{m.get('name')}/hunt.jsonl")
+            continue
+        prev_gen = -1
+        with open(path) as f:
+            for ln, raw in enumerate(f, 1):
+                try:
+                    row = json.loads(raw)
+                except json.JSONDecodeError as ex:
+                    errors.append(f"{m['name']}/hunt.jsonl:{ln}: not JSON: {ex}")
+                    continue
+                for k in HUNT_INT_FIELDS:
+                    if not isinstance(row.get(k), int) or row.get(k) is True:
+                        errors.append(
+                            f"{m['name']}/hunt.jsonl:{ln}: field {k!r} "
+                            "missing or non-int"
+                        )
+                for k in HUNT_FLOAT_FIELDS:
+                    if not isinstance(row.get(k), (int, float)):
+                        errors.append(
+                            f"{m['name']}/hunt.jsonl:{ln}: field {k!r} "
+                            "missing or non-numeric"
+                        )
+                if isinstance(row.get("gen"), int):
+                    if row["gen"] != prev_gen + 1:
+                        errors.append(
+                            f"{m['name']}/hunt.jsonl:{ln}: gen {row['gen']} "
+                            f"(expected {prev_gen + 1})"
+                        )
+                    prev_gen = row["gen"]
+        # Contiguity alone passes a tail-truncated stream; the manifest
+        # knows how many generations actually ran.
+        if (
+            isinstance(man.get("generations_run"), int)
+            and prev_gen + 1 != man["generations_run"]
+        ):
+            errors.append(
+                f"{m['name']}/hunt.jsonl: {prev_gen + 1} generation rows, "
+                f"manifest claims {man['generations_run']} -- stream "
+                "truncated"
+            )
+    if man.get("negative") and not os.path.isfile(
+        os.path.join(directory, "negative.json")
+    ):
+        errors.append("manifest claims a negative result but negative.json missing")
+    perf_path = os.path.join(directory, "perf.jsonl")
+    if os.path.isfile(perf_path):
+        from raft_sim_tpu.utils.telemetry_sink import (
+            PERF_BOOL_FIELDS, PERF_FLOAT_FIELDS, PERF_INT_FIELDS,
+        )
+
+        with open(perf_path) as f:
+            for ln, raw in enumerate(f, 1):
+                try:
+                    row = json.loads(raw)
+                except json.JSONDecodeError as ex:
+                    errors.append(f"perf.jsonl:{ln}: not JSON: {ex}")
+                    continue
+                for k in PERF_INT_FIELDS:
+                    if not isinstance(row.get(k), int) or row.get(k) is True:
+                        errors.append(f"perf.jsonl:{ln}: field {k!r} missing or non-int")
+                for k in PERF_BOOL_FIELDS:
+                    if not isinstance(row.get(k), bool):
+                        errors.append(f"perf.jsonl:{ln}: field {k!r} missing or non-bool")
+                for k in PERF_FLOAT_FIELDS:
+                    v = row.get(k)
+                    if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+                        errors.append(
+                            f"perf.jsonl:{ln}: field {k!r} missing or not a "
+                            "non-negative number"
+                        )
+    return errors

@@ -1,0 +1,287 @@
+"""The self-growing safety corpus: dedup, provenance, and the checker gate.
+
+tests/corpus/ used to grow by hand: a human ran one hunt, shrank the hit,
+and committed the artifact. This module is the farm's freezer -- the policy
+that lets the CI job itself grow the corpus without growing noise:
+
+  signature   a hit's identity is (kernel, violation-kinds, mechanism-set):
+              which kernel broke, which invariants fired, and which fault
+              mechanisms SURVIVED the shrink (the minimal causal set). Two
+              hits with the same signature are the same bug reached twice.
+  dedup       a new artifact whose mechanism set equals -- or is a
+              subset/superset of -- an existing same-kernel same-kinds
+              artifact's is REFUSED: a repro needing strictly more
+              mechanisms for the same break adds no regression value, and a
+              strictly-more-minimal one would just churn the corpus.
+  provenance  every frozen artifact records who found it (fitness member,
+              generation, seed, the shrink's ablation set, the farm
+              manifest hash), so a corpus file is an audit trail, not just
+              a replay input (schema rev: scenario-repro-v2; the validator
+              REJECTS provenance-free artifacts).
+  checker     before freezing, the artifact's fleet slice is replayed
+              traced (batch-1, the same trajectory -- batched-parity
+              pinned) and the six-property whole-history checker
+              (trace/checker.py) must REJECT it naming a property: the
+              corpus regresses safety SEMANTICS, not just tick-exactness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import glob
+import json
+import math
+import os
+
+import jax
+import numpy as np
+
+from raft_sim_tpu.scenario import genome as genome_mod
+from raft_sim_tpu.scenario import shrink as shrink_mod
+from raft_sim_tpu.utils.config import RaftConfig
+
+# The corpus-artifact schema: scenario-repro-v1 plus the REQUIRED provenance
+# block. Replay tooling accepts v1 too (shrink.ARTIFACT_SCHEMAS); the corpus
+# validator does not.
+CORPUS_SCHEMA = "scenario-repro-v2"
+
+PROVENANCE_FIELDS = ("mutant", "fitness", "generation", "seed", "ablated")
+
+CORE_FIELDS = (
+    "seed", "batch", "cluster", "seg_len", "ticks", "tick", "kinds",
+    "genome_raw",
+)
+
+
+# Per-mechanism GATING fields: the mechanism occurs iff ALL of these are
+# nonzero (labels are shrink.ABLATIONS' vocabulary). Partitions need both
+# the activation threshold AND a window period -- shrink's halving phase can
+# zero `part` while leaving `part_period` standing, and a period without a
+# threshold provably fires nothing, so any-field-nonzero would report a
+# phantom mechanism and mis-split dedup signatures. crash_down is a span,
+# not a gate: it is meaningless without `crash`.
+MECHANISM_GATES = {
+    "clock skew": ("skew",),
+    "client traffic": ("client_interval",),
+    "leadership transfers": ("transfer_interval",),
+    "reads": ("read_interval",),
+    "membership changes": ("reconfig_interval",),
+    "message drop": ("drop",),
+    "partitions": ("part", "part_period"),
+    "crashes": ("crash",),
+}
+assert set(MECHANISM_GATES) == {label for label, _ in shrink_mod.ABLATIONS}
+
+
+def mechanisms(art: dict) -> frozenset:
+    """The fault mechanisms ACTIVE in an artifact's minimized genome: the
+    shrink ablation-group labels whose gating fields ALL survived nonzero
+    (MECHANISM_GATES). This is the causal half of the dedup signature: what
+    the shrink could not remove."""
+    raw = art["genome_raw"]
+    out = set()
+    for label, gates in MECHANISM_GATES.items():
+        if all(f in raw and np.asarray(raw[f]).any() for f in gates):
+            out.add(label)
+    return frozenset(out)
+
+
+def signature(art: dict) -> tuple:
+    """(kernel, violation-kinds, mechanism-set): the dedup identity."""
+    kernel = art.get("mutant") or "real"
+    return (kernel, tuple(sorted(art["kinds"])), mechanisms(art))
+
+
+def load_corpus(directory: str) -> list[tuple[str, dict]]:
+    """Every artifact in a corpus directory, sorted by name."""
+    return [
+        (p, shrink_mod.load_artifact(p))
+        for p in sorted(glob.glob(os.path.join(directory, "*.json")))
+    ]
+
+
+def find_duplicate(art: dict, corpus_dir: str) -> dict | None:
+    """The existing artifact a new hit duplicates, or None. Same kernel +
+    same violation kinds + mechanism sets nested either way = duplicate
+    (module docstring has the rationale). Returns {"path", "signature",
+    "duplicate_of"} for the farm's dedup ledger."""
+    if not os.path.isdir(corpus_dir):
+        return None
+    kernel, kinds, mech = signature(art)
+    for path, old in load_corpus(corpus_dir):
+        k2, kinds2, mech2 = signature(old)
+        if kernel == k2 and kinds == kinds2 and (mech <= mech2 or mech2 <= mech):
+            return {
+                "path": path,
+                "signature": [kernel, list(kinds), sorted(mech)],
+                "duplicate_of": os.path.basename(path),
+            }
+    return None
+
+
+def validate_artifact(art: dict) -> list[str]:
+    """Problems with a corpus-grade artifact ([] = valid). Replay-grade v1
+    artifacts FAIL here: the corpus requires the v2 provenance block --
+    tests/test_corpus.py runs this over every frozen file."""
+    errs = []
+    if art.get("schema") != CORPUS_SCHEMA:
+        errs.append(
+            f"schema {art.get('schema')!r}: corpus artifacts must be "
+            f"{CORPUS_SCHEMA} (provenance-stamped)"
+        )
+    for k in CORE_FIELDS:
+        if k not in art:
+            errs.append(f"missing core field {k!r}")
+    prov = art.get("provenance")
+    if not isinstance(prov, dict):
+        errs.append("missing provenance block (who found this, and how?)")
+        return errs
+    for k in PROVENANCE_FIELDS:
+        if k not in prov:
+            errs.append(f"provenance: missing field {k!r}")
+    if "generation" in prov and not (
+        prov["generation"] is None or isinstance(prov["generation"], int)
+    ):
+        errs.append("provenance: generation must be an int or null")
+    if "seed" in prov and not isinstance(prov["seed"], int):
+        errs.append("provenance: seed must be an int")
+    if "ablated" in prov and not isinstance(prov["ablated"], list):
+        errs.append("provenance: ablated must be the shrink ablation list")
+    if "mutant" in prov and prov["mutant"] != art.get("mutant"):
+        errs.append(
+            f"provenance: mutant {prov.get('mutant')!r} disagrees with the "
+            f"artifact's kernel label {art.get('mutant')!r}"
+        )
+    return errs
+
+
+def stamp(art: dict, provenance: dict) -> dict:
+    """A v2 corpus artifact from a shrink output + provenance facts. The
+    ablation set defaults to the artifact's own `removed` record."""
+    prov = dict(provenance)
+    prov.setdefault("mutant", art.get("mutant"))
+    prov.setdefault("ablated", list(art.get("removed", [])))
+    out = dict(art, schema=CORPUS_SCHEMA, provenance=prov)
+    problems = validate_artifact(out)
+    if problems:
+        raise ValueError(f"artifact failed corpus validation: {problems}")
+    return out
+
+
+# ----------------------------------------------------- the checker gate
+
+
+@functools.lru_cache(maxsize=16)
+def _traced_replay_fn(cfg: RaftConfig, n_ticks: int, window: int,
+                      seg_len: int, depth: int):
+    """One jitted batch-1 traced windowed replay per shape -- same-shape
+    artifacts share it (and the farm's freeze + the tier-1 corpus checker
+    test share THIS cache)."""
+    from raft_sim_tpu.sim import telemetry
+    from raft_sim_tpu.trace.ring import TraceSpec
+
+    spec = TraceSpec(depth=depth)
+    fn = jax.jit(
+        lambda s, k, g: telemetry.run_batch_minor_telemetry(
+            cfg, s, k, n_ticks, window, None, genome=g, seg_len=seg_len,
+            trace_spec=spec,
+        )
+    )
+    return fn, spec
+
+
+def check_artifact(art: dict, real: bool = False, window: int = 64,
+                   depth: int = 512):
+    """Replay an artifact's cluster TRACED and run the six-property
+    whole-history checker over it. `real=False` replays the artifact's own
+    kernel (mutant included) -- the freeze gate expects a REJECTION naming a
+    property; `real=True` strips the mutant -- the fixed kernel under the
+    identical (genome, seed, faults) must PASS all six.
+
+    The replay is the artifact's single fleet slice at batch 1 (bit-exact
+    with its batched run -- the parity contract), horizon rounded UP to
+    whole windows: running past the violation only gives the checker more
+    history. Returns the trace CheckReport."""
+    from raft_sim_tpu import init_batch
+    from raft_sim_tpu.trace import checker as checker_mod
+    from raft_sim_tpu.trace import history as history_mod
+
+    cfg = (
+        RaftConfig(**art.get("config", {}))
+        if real
+        else shrink_mod.artifact_config(art)
+    )
+    cfg = dataclasses.replace(cfg, track_trace=True)
+    n_ticks = int(math.ceil(int(art["ticks"]) / window)) * window
+    fn, spec = _traced_replay_fn(
+        cfg, n_ticks, window, int(art["seg_len"]), depth
+    )
+    root = jax.random.key(int(art["seed"]))
+    k_init, k_run = jax.random.split(root)
+    state = init_batch(cfg, k_init, int(art["batch"]))
+    keys = jax.random.split(k_run, int(art["batch"]))
+    c = int(art["cluster"])
+    state1 = jax.tree.map(lambda v: v[c:c + 1], state)
+    g = genome_mod.broadcast(genome_mod.from_raw(art["genome_raw"]), 1)
+    out = fn(state1, keys[c:c + 1], g)
+    traws = out[4]  # (state, metrics, records, recorder, traws, tp)
+    hist = history_mod.from_device(jax.device_get(traws), spec)
+    return checker_mod.check_history(hist)
+
+
+# ------------------------------------------------------------- freezing
+
+
+def default_name(art: dict) -> str:
+    """`<kernel>-n<N>` -- the established corpus naming (weak-quorum-n5)."""
+    kernel = art.get("mutant") or "real"
+    n = RaftConfig(**art.get("config", {})).n_nodes
+    return f"{kernel}-n{n}"
+
+
+def freeze(
+    art: dict,
+    corpus_dir: str,
+    provenance: dict,
+    name: str | None = None,
+    window: int = 64,
+    depth: int = 512,
+) -> tuple[str, dict]:
+    """Stamp + checker-gate + write one artifact into the corpus. Raises if
+    the checker fails to REJECT the artifact's kernel (a hit the six
+    properties cannot see must not enter the safety corpus as if they
+    could), or if the stamped artifact fails validation. Dedup is the
+    CALLER's gate (find_duplicate) -- freezing is unconditional by then.
+    Returns (path, stamped artifact); the rejected property lands in
+    provenance["checker_property"]."""
+    rep = check_artifact(art, window=window, depth=depth)
+    if not rep.violated:
+        state = "passed" if rep.ok else "was undecided on"
+        raise ValueError(
+            f"refusing to freeze: the six-property checker {state} the "
+            f"artifact's replay (complete={rep.complete}, problems="
+            f"{rep.problems[:2]}) -- the corpus regresses safety semantics, "
+            "so a hit the checker cannot name does not belong in it"
+        )
+    prov = dict(provenance, checker_property=rep.violated[0])
+    art2 = stamp(art, prov)
+    os.makedirs(corpus_dir, exist_ok=True)
+    base = name or default_name(art2)
+    path = os.path.join(corpus_dir, f"{base}.json")
+    i = 2
+    while os.path.exists(path):
+        path = os.path.join(corpus_dir, f"{base}-{i}.json")
+        i += 1
+    shrink_mod.save_artifact(path, art2)
+    return path, art2
+
+
+def backfill_provenance(path: str, provenance: dict) -> dict:
+    """Upgrade a v1 artifact file in place to the v2 corpus schema (the
+    one-time migration for the hand-frozen seed artifacts; new freezes go
+    through freeze())."""
+    art = shrink_mod.load_artifact(path)
+    art2 = stamp(art, provenance)
+    shrink_mod.save_artifact(path, art2)
+    return art2

@@ -110,6 +110,14 @@ class SearchSpec:
     # needs the bitmap, so a shallow buffer keeps the carry cheap; events
     # past it are counted, not kept.
     trace_depth: int = 32
+    # Proposal mode. "gaussian" (default): classic CE draws from N(mu,
+    # sigma). "coverage-guided": up to `guided_frac` of each generation are
+    # mutated clones of the previous generation's novelty-lit parents
+    # (propose_coverage_guided -- coverage-guided MUTATION, the AFL move, on
+    # top of coverage-as-fitness; requires fitness="coverage" for the
+    # novelty signal). Deterministic per (genome, seed) either way.
+    proposal: str = "gaussian"
+    guided_frac: float = 0.5
     # CE smoothing toward the elite statistics (1.0 = classic full refit).
     # Each generation re-seeds the simulator, so fitness is NOISY; a full
     # refit lets one lucky generation yank the distribution off a promising
@@ -143,30 +151,50 @@ def _decode_row(cfg: RaftConfig, knobs, x: np.ndarray) -> genome_mod.ScenarioGen
     return genome_mod.from_segments([genome_mod.segment(**params)])
 
 
+# The farm (raft_sim_tpu/farm) decodes its portfolio members' knob vectors
+# through the same function, so one knob vocabulary serves every hunter.
+decode_row = _decode_row
+
+
+# Distress-signal extractors shared by the scalar blend below and the
+# farm's portfolio members (farm/portfolio.py) -- ONE interpretation of the
+# telemetry counters, so a sentinel or encoding change in the window plane
+# cannot silently fork the two.
+
+
+def leaderless_windows(records) -> np.ndarray:
+    """[B] windows whose fold saw any leaderless tick: such a window carries
+    last_leaderless_tick >= 0 (absolute ticks; the window-local fold starts
+    at the -1 sentinel)."""
+    return (np.asarray(records.metrics.last_leaderless_tick) >= 0).sum(axis=1)
+
+
+def term_churn(metrics) -> np.ndarray:
+    """[B] elections burned over the run (terms start at 1)."""
+    return np.maximum(np.asarray(metrics.max_term) - 1, 0)
+
+
+def commit_stalls(records, metrics) -> np.ndarray:
+    """[B] windows where max_commit failed to advance past the previous
+    window's high-water mark (only meaningful under a client workload; zero
+    contribution without one)."""
+    mc = np.asarray(records.metrics.max_commit)  # [B, W], absolute high-water
+    stalls = (np.diff(mc, axis=1) <= 0).sum(axis=1) if mc.shape[1] > 1 else 0
+    return stalls * (np.asarray(metrics.total_cmds) > 0)
+
+
 def fitness_from_records(records, metrics) -> np.ndarray:
     """[B] fitness from the telemetry window counters (higher = closer to
     breaking). All host-side numpy over the already-fetched records."""
     viol = np.asarray(metrics.violations, np.float64)
-    # Leaderless windows: a window whose fold saw any leaderless tick carries
-    # last_leaderless_tick >= 0 (absolute ticks; the window-local fold starts
-    # at the -1 sentinel).
-    leaderless = (np.asarray(records.metrics.last_leaderless_tick) >= 0).sum(axis=1)
-    # Term churn: elections burned over the run (terms start at 1).
-    churn = np.maximum(np.asarray(metrics.max_term) - 1, 0)
-    # Commit stalls: windows where max_commit failed to advance past the
-    # previous window's high-water mark (only meaningful under a client
-    # workload; zero contribution without one).
-    mc = np.asarray(records.metrics.max_commit)  # [B, W], absolute high-water
-    stalls = (np.diff(mc, axis=1) <= 0).sum(axis=1) if mc.shape[1] > 1 else 0
-    stalls = stalls * (np.asarray(metrics.total_cmds) > 0)
     lat_ex = np.asarray(metrics.lat_excluded, np.float64)
     multi = np.asarray(metrics.multi_leader, np.float64)
     return (
         W_VIOLATION * viol
         + W_MULTI_LEADER * multi
-        + W_LEADERLESS_WINDOW * leaderless
-        + W_COMMIT_STALL * stalls
-        + W_TERM_CHURN * churn
+        + W_LEADERLESS_WINDOW * leaderless_windows(records)
+        + W_COMMIT_STALL * commit_stalls(records, metrics)
+        + W_TERM_CHURN * term_churn(metrics)
         + W_LAT_EXCLUDED * lat_ex
     )
 
@@ -181,17 +209,93 @@ def _popcount_words(words: np.ndarray) -> np.ndarray:
     return np_popcount_u32(words).sum(axis=0)
 
 
+def coverage_novelty(cov: np.ndarray, seen: np.ndarray) -> np.ndarray:
+    """[B] novelty counts: bits each cluster's [C, B] coverage bitmap sets
+    beyond the accumulated [C] seen-bit union. Scoring is against the union
+    as handed in (every cluster of one generation against the same baseline
+    -- deterministic and order-free); the caller unions `cov` in afterwards
+    (`seen_union`), which keeps multi-consumer scoring -- the farm's
+    portfolio members share one hunt-wide seen set -- monotone and
+    member-order-free."""
+    cov = np.asarray(cov, np.uint32)
+    return _popcount_words(cov & ~seen[:, None])
+
+
+def seen_union(cov: np.ndarray, seen: np.ndarray) -> np.ndarray:
+    """The updated [C] seen-bit union after a [C, B] generation bitmap."""
+    return seen | np.bitwise_or.reduce(np.asarray(cov, np.uint32), axis=1)
+
+
 def coverage_fitness(cov: np.ndarray, seen: np.ndarray, violations) -> tuple[np.ndarray, np.ndarray]:
     """([B] fitness, updated seen) from a [C, B] per-cluster coverage bitmap
     and the search's accumulated [C] seen-bit union. Novelty = bits this
-    cluster sets beyond everything seen BEFORE this generation (all clusters
-    of one generation score against the same baseline -- deterministic and
-    order-free); violations stay lexicographically dominant."""
-    cov = np.asarray(cov, np.uint32)
-    novel = cov & ~seen[:, None]
-    fit = W_VIOLATION * np.asarray(violations, np.float64) + _popcount_words(novel)
-    seen = seen | np.bitwise_or.reduce(cov, axis=1)
-    return fit, seen
+    cluster sets beyond everything seen BEFORE this generation; violations
+    stay lexicographically dominant -- an all-bits-already-seen generation
+    (novelty 0 everywhere) still ranks violating clusters first."""
+    fit = W_VIOLATION * np.asarray(violations, np.float64) + coverage_novelty(cov, seen)
+    return fit, seen_union(cov, seen)
+
+
+# --------------------------------------------------------------- proposals
+
+
+def propose_gaussian(rng, mu: np.ndarray, sigma: np.ndarray, n: int) -> np.ndarray:
+    """The classic CE proposal: n knob vectors ~ N(mu, sigma), clipped to the
+    normalized cube."""
+    return np.clip(rng.normal(mu, sigma, size=(n, mu.shape[0])), 0.0, 1.0)
+
+
+def _parent_entropy(seed: int, x: np.ndarray) -> list[int]:
+    """Deterministic rng entropy for one parent genome: the base seed plus
+    the parent's knob vector quantized to the uint32 grid. Two searches with
+    the same (genome, seed) mutate identically; any knob difference forks the
+    stream."""
+    return [int(seed) & 0xFFFFFFFF] + [
+        int(v) for v in (np.clip(x, 0.0, 1.0) * 0xFFFFFFFF).astype(np.uint64)
+    ]
+
+
+def propose_coverage_guided(
+    rng,
+    mu: np.ndarray,
+    sigma: np.ndarray,
+    n: int,
+    parents: np.ndarray | None,
+    parent_novelty: np.ndarray | None,
+    seed: int,
+    frac: float = 0.5,
+    mut_scale: float = 0.25,
+) -> np.ndarray:
+    """Coverage-guided mutation: AFL's core move (mutate what reached new
+    coverage) on the CE population. Up to `frac` of the proposals are
+    MUTATED CLONES of the previous generation's novelty-lit parents --
+    genomes whose windows set (role x kind)/(kind -> kind) bits the hunt had
+    never seen -- perturbed at the current sigma; the rest stay classic CE
+    draws, so the distribution-level update keeps converging while the
+    guided half exploits frontier genomes the mean/sigma statistics would
+    average away. Mutation is SMALL by design (`mut_scale` x sigma): a
+    frontier parent is a working key into rare behavior, and a full-sigma
+    perturbation would be a fresh draw that forgets it (measured: at
+    mut_scale 1.0 guided loses the bits-lit A/B it wins at 0.25 --
+    tests/test_farm.py pins the win). Each child's noise stream is
+    deterministic per (parent genome, seed) (`_parent_entropy`),
+    independent of population layout, so a guided hunt replays exactly.
+    With no lit parents (first generation, or a dry one) this degrades to
+    the gaussian proposal."""
+    if parents is None or parent_novelty is None or not np.any(parent_novelty > 0):
+        return propose_gaussian(rng, mu, sigma, n)
+    lit = np.flatnonzero(parent_novelty > 0)
+    # Richest parents first (stable ties by population index).
+    lit = lit[np.argsort(-parent_novelty[lit], kind="stable")]
+    n_guided = min(int(round(frac * n)), n)
+    xs = propose_gaussian(rng, mu, sigma, n)
+    for j in range(n_guided):
+        p = parents[lit[j % lit.size]]
+        crng = np.random.default_rng(_parent_entropy(seed, p) + [j])
+        xs[n - 1 - j] = np.clip(
+            p + crng.normal(0.0, sigma * mut_scale), 0.0, 1.0
+        )
+    return xs
 
 
 @dataclasses.dataclass
@@ -226,6 +330,15 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
     if spec.fitness not in ("scalar", "coverage"):
         raise ValueError(f"unknown fitness mode {spec.fitness!r} "
                          "(have: scalar, coverage)")
+    if spec.proposal not in ("gaussian", "coverage-guided"):
+        raise ValueError(f"unknown proposal mode {spec.proposal!r} "
+                         "(have: gaussian, coverage-guided)")
+    if spec.proposal == "coverage-guided" and spec.fitness != "coverage":
+        raise ValueError(
+            "proposal='coverage-guided' needs fitness='coverage': guided "
+            "mutation selects parents by the novelty bits only the coverage "
+            "bitmap provides"
+        )
     trace_spec = None
     seen = None
     if spec.fitness == "coverage":
@@ -247,13 +360,19 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
     gens: list[dict] = []
     hit: dict | None = None
     best_x, best_fit = None, -np.inf
+    prev_xs: np.ndarray | None = None  # coverage-guided parent pool
+    prev_novelty: np.ndarray | None = None
     if perf is not None:
         perf.add_probe("telemetry.simulate_windowed", telemetry.simulate_windowed)
 
     for gen in range(spec.generations):
-        xs = np.clip(
-            rng.normal(mu, sigma, size=(spec.population, dim)), 0.0, 1.0
-        )
+        if spec.proposal == "coverage-guided":
+            xs = propose_coverage_guided(
+                rng, mu, sigma, spec.population, prev_xs, prev_novelty,
+                spec.seed, frac=spec.guided_frac,
+            )
+        else:
+            xs = propose_gaussian(rng, mu, sigma, spec.population)
         if spec.carry_best and best_x is not None:
             xs[0] = best_x
         rows = [_decode_row(cfg, knobs, x) for x in xs]
@@ -288,10 +407,12 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
             cov_new = None
         else:
             before = int(_popcount_words(seen[:, None])[0])
-            fit, seen = coverage_fitness(
-                np.asarray(tp.cov), seen, metrics.violations
-            )
+            cov = np.asarray(tp.cov)
+            novelty = coverage_novelty(cov, seen)
+            fit = W_VIOLATION * np.asarray(metrics.violations, np.float64) + novelty
+            seen = seen_union(cov, seen)
             cov_new = int(_popcount_words(seen[:, None])[0]) - before
+            prev_xs, prev_novelty = xs, novelty
         order = np.argsort(-fit)
         elites = xs[order[:n_elite]]
         a = spec.smoothing
@@ -343,6 +464,7 @@ def search(cfg: RaftConfig, spec: SearchSpec | None = None,
             "elite_frac": spec.elite_frac,
             "seed": spec.seed,
             "fitness": spec.fitness,
+            "proposal": spec.proposal,
             "knobs": [dataclasses.asdict(k) for k in knobs],
         },
     )

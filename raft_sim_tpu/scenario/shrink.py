@@ -190,10 +190,19 @@ def save_artifact(path: str, art: dict) -> str:
     return path
 
 
+# Replayable artifact schemas. v1 is the raw shrink output; v2 adds the
+# REQUIRED provenance block (farm/corpus.py stamps it: who found the hit,
+# which fitness member, which generation/seed, what the shrink ablated, and
+# the farm manifest hash) -- corpus-frozen artifacts must be v2
+# (farm.corpus.validate_artifact), but the replayer accepts both: replay
+# depends only on (config, mutant, genome, seed, horizon), which v1 carries.
+ARTIFACT_SCHEMAS = ("scenario-repro-v1", "scenario-repro-v2")
+
+
 def load_artifact(path: str) -> dict:
     with open(path) as f:
         art = json.load(f)
-    if art.get("schema") != "scenario-repro-v1":
+    if art.get("schema") not in ARTIFACT_SCHEMAS:
         raise ValueError(f"not a scenario repro artifact: {path}")
     return art
 

@@ -289,6 +289,12 @@ def test_waiver_file_format_errors_are_loud(tmp_path):
     assert F.load_waivers(str(tmp_path / "missing.json")) == ([], [])
 
 
+@pytest.mark.slow  # budget re-tier (PR 12): gate integrity for PARTIAL
+# runs (a --ast-only run must not mark jaxpr-pass waivers stale) -- the
+# full-run staleness path stays tier-1 via test_tree_gates_clean and the
+# CI check job runs --all on every push, so a regression here cannot land
+# silently; the partial-run permutation (two full pass invocations) rides
+# the slow tier.
 def test_partial_run_does_not_report_other_passes_waivers_stale():
     # The standing waivers belong to the AST pass; a jaxpr-only run must not
     # condemn them as stale (they were never given a chance to match).

@@ -1,22 +1,32 @@
 """Regression corpus: every bug the violation hunt ever found stays found.
 
-tests/corpus/ holds shrunk `scenario-repro-v1` artifacts (scenario/shrink.py)
--- one per historical hunt hit, named `<mutant>-<topology>.json`. Each must
-replay BIT-EXACTLY (identical violation tick AND kinds) via tools/repro.py,
-the same replayer CI's scenario smoke uses: a drifting replay means the
-(genome, seed, kernel) bookkeeping broke, and a clean replay of a mutant
-artifact on a FIXED kernel would mean the regression resurfaced the bug's
-preconditions without its effect -- either way the corpus is the tripwire.
+tests/corpus/ holds shrunk scenario-repro-v2 artifacts (scenario/shrink.py
+output, provenance-stamped per farm/corpus.py) -- one per historical hunt
+hit, named `<mutant>-<topology>.json`. Three gates per artifact:
 
-Artifacts are deliberately SMALL (N=5, short horizons): replaying the corpus
-costs one tiny scan compile per artifact, so it can grow by dozens before
-threatening the tier-1 budget. Seed additions: the weak-quorum election-
-safety hit and the blind-transfer commit-invariant hit (the PR-10
-reconfiguration plane's coup mutant), both hunted, shrunk, and frozen here;
-PR 11 adds the lease-skew read-staleness hit (a skewed-clock lease violation
--- the shrink RETAINED clock skew and partitions, the clock assumption made
-load-bearing; tests/test_lease.py pins the real kernel clean on the same
-genome).
+  1. BIT-EXACT REPLAY: `tools/repro.py --corpus tests/corpus` replays every
+     artifact in one process (shared jitted-replay cache) and exits nonzero
+     naming the first drifting artifact -- the same command CI's farm smoke
+     runs, so the tier-1 gate and CI cannot diverge. A drifting replay means
+     the (genome, seed, kernel) bookkeeping broke; a clean replay of a
+     mutant artifact would mean the regression resurfaced the bug's
+     preconditions without its effect.
+  2. PROVENANCE: the corpus validator (farm/corpus.py) rejects any artifact
+     without the v2 provenance block -- who found it, which fitness member,
+     which generation/seed, what the shrink ablated, which checker property
+     it violates. The corpus is an audit trail, not just replay inputs.
+  3. SAFETY SEMANTICS: the six-property whole-history checker
+     (trace/checker.py) runs over every artifact's traced replay -- the
+     mutant kernel must be REJECTED naming the provenance's recorded
+     property, and the REAL kernel under the identical (genome, seed,
+     faults) must PASS all six. The corpus regresses safety semantics, not
+     just tick-exactness (before the farm, only lease-skew got checker
+     treatment, and only in the slow tier/CI).
+
+Artifacts are deliberately SMALL (N=5, short horizons). Seeds: the
+weak-quorum election-safety hit, the blind-transfer commit-invariant hit
+(PR 10), and the lease-skew read-staleness hit (PR 11) -- hunted, shrunk,
+frozen; provenance backfilled by PR 12 (the farm freezes new ones itself).
 """
 
 from __future__ import annotations
@@ -30,30 +40,100 @@ import pytest
 
 CORPUS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "corpus")
 ARTIFACTS = sorted(glob.glob(os.path.join(CORPUS_DIR, "*.json")))
+_IDS = [os.path.basename(p) for p in ARTIFACTS]
 
 
 def test_corpus_is_seeded():
-    """The corpus exists and carries at least the two seed artifacts."""
+    """The corpus exists and carries at least the three seed artifacts."""
     names = {os.path.basename(p) for p in ARTIFACTS}
     assert "weak-quorum-n5.json" in names
     assert "blind-transfer-n5.json" in names
     assert "lease-skew-n5.json" in names
 
 
-@pytest.mark.parametrize(
-    "artifact", ARTIFACTS, ids=[os.path.basename(p) for p in ARTIFACTS]
-)
-def test_corpus_artifact_replays_bit_exactly(artifact):
+def test_corpus_replays_bit_exactly_in_one_command():
+    """tools/repro.py --corpus: the whole corpus in ONE subprocess (one jax
+    import, shared replay cache) -- exit 0 iff every artifact reproduces at
+    its identical tick with identical kinds."""
     repo = os.path.dirname(CORPUS_DIR.rstrip(os.sep)).rsplit(os.sep, 1)[0]
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "tools", "repro.py"),
-         "--scenario", artifact],
+         "--corpus", CORPUS_DIR],
         capture_output=True,
         text=True,
         timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert proc.returncode == 0, (
-        f"{os.path.basename(artifact)} did not replay bit-exactly "
-        f"(exit {proc.returncode}):\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        f"corpus drifted (exit {proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
     )
+
+
+@pytest.mark.parametrize("artifact", ARTIFACTS, ids=_IDS)
+def test_corpus_artifact_has_provenance(artifact):
+    """Every frozen artifact is corpus-grade: scenario-repro-v2 with the
+    full provenance block (the validator is the farm's freeze gate)."""
+    from raft_sim_tpu.farm import corpus as corpus_mod
+    from raft_sim_tpu.scenario import shrink as shrink_mod
+
+    art = shrink_mod.load_artifact(artifact)
+    assert corpus_mod.validate_artifact(art) == []
+    prov = art["provenance"]
+    assert prov["checker_property"] in (
+        "election_safety", "leader_append_only", "log_matching",
+        "leader_completeness", "state_machine_safety", "read_linearizability",
+    )
+
+
+def test_validator_rejects_provenance_free_artifact():
+    """A replay-grade v1 artifact (or a stripped v2) must NOT validate as
+    corpus-grade: the corpus schema rev exists to make provenance load-
+    bearing, not decorative."""
+    from raft_sim_tpu.farm import corpus as corpus_mod
+    from raft_sim_tpu.scenario import shrink as shrink_mod
+
+    art = shrink_mod.load_artifact(ARTIFACTS[0])
+    stripped = {k: v for k, v in art.items() if k != "provenance"}
+    problems = corpus_mod.validate_artifact(stripped)
+    assert any("provenance" in p for p in problems), problems
+    legacy = dict(stripped, schema="scenario-repro-v1")
+    problems = corpus_mod.validate_artifact(legacy)
+    assert any("schema" in p for p in problems), problems
+    # Provenance disagreeing with the artifact's kernel label is corruption.
+    lying = dict(art, provenance=dict(art["provenance"], mutant="other"))
+    assert any("mutant" in p for p in corpus_mod.validate_artifact(lying))
+
+
+@pytest.mark.parametrize("artifact", ARTIFACTS, ids=_IDS)
+def test_checker_rejects_mutant_replay_naming_its_property(artifact):
+    """The six-property whole-history checker over the artifact's traced
+    replay must REJECT the mutant kernel naming the provenance's recorded
+    property, on a COMPLETE history (an undecided rejection would be a
+    trace-depth bug, not a safety verdict)."""
+    from raft_sim_tpu.farm import corpus as corpus_mod
+    from raft_sim_tpu.scenario import shrink as shrink_mod
+
+    art = shrink_mod.load_artifact(artifact)
+    rep = corpus_mod.check_artifact(art)
+    assert rep.complete, rep.problems
+    assert art["provenance"]["checker_property"] in rep.violated, (
+        f"expected {art['provenance']['checker_property']}, "
+        f"checker violated={rep.violated}"
+    )
+    # The named property carries a minimal witness, not just a verdict.
+    assert rep.results[art["provenance"]["checker_property"]].witness
+
+
+@pytest.mark.parametrize("artifact", ARTIFACTS, ids=_IDS)
+def test_checker_passes_real_kernel_on_same_replay(artifact):
+    """The REAL kernel under the identical (genome, seed, faults, horizon)
+    must pass all six properties on a complete history: the corpus artifact
+    demonstrates the mutant's bug, not an environmental accident."""
+    from raft_sim_tpu.farm import corpus as corpus_mod
+    from raft_sim_tpu.scenario import shrink as shrink_mod
+
+    art = shrink_mod.load_artifact(artifact)
+    rep = corpus_mod.check_artifact(art, real=True)
+    assert rep.complete, rep.problems
+    assert rep.ok, {n: r.note for n, r in rep.results.items() if not r.ok}

@@ -147,6 +147,12 @@ def test_recompile_watchdog_fires_on_forced_recompile(perf_run):
     assert "chunked._chunk_donate" in err.getvalue()
 
 
+@pytest.mark.slow  # budget re-tier (PR 12): the profiler context wraps the
+# UNCHANGED jitted calls (capture-vs-no-capture is a jax-runtime property,
+# not a program of ours), and the serve/search profile captures already ride
+# the slow tier -- this run-loop capture guard joins them; every other
+# test_obs row (bit-exactness of instrumented runs, watchdog, schema) stays
+# tier-1.
 def test_profile_capture_is_bit_exact(tmp_path):
     """Tier-1 guard for the promoted --profile flag: a run captured under
     jax.profiler.trace equals an uncaptured run bit-for-bit."""

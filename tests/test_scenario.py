@@ -301,6 +301,12 @@ def test_fitness_prefers_distress():
 # ------------------------------------------------- shrink + bit-exact replay
 
 
+@pytest.mark.slow  # budget re-tier (PR 12): the shrink -> bit-exact-replay
+# contract is pinned every tier-1 run by the farm's fresh-freeze test
+# (shrinks a hit, freezes it, replays via tools/repro.py --corpus) and the
+# corpus one-command replay over tests/corpus; this direct pipeline form
+# (plus the --scenario CLI leg, which CI's scenario smoke runs) rides the
+# slow tier with the rest of the hunt soaks.
 def test_shrink_minimizes_and_replays_to_identical_tick(mutant_hit, tmp_path):
     mcfg, res = mutant_hit
     art = shrink_mod.shrink(mcfg, res.hit, mutant="weak-quorum")

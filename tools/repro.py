@@ -27,6 +27,12 @@ against an artificially broken kernel (quorum - 1).
 exact kernel (including TEST-ONLY mutants), reruns the minimized (genome,
 seed) at the trimmed horizon, and exits 0 iff the violation reproduces at
 the IDENTICAL tick with identical kinds -- the CI scenario smoke contract.
+
+`--corpus DIR` batch-replays EVERY artifact in a corpus directory in one
+process (one jax import; same-shape artifacts share the replay compile via
+scenario/shrink.py's jitted-replay cache), printing one JSON line per
+artifact and exiting nonzero NAMING THE FIRST DRIFTING ARTIFACT -- the one
+command tier-1's tests/test_corpus.py and CI both converge on.
 """
 
 from __future__ import annotations
@@ -154,6 +160,45 @@ def replay_scenario(path: str, context: int) -> int:
     return 0 if res["reproduced"] else 2
 
 
+def replay_corpus(directory: str) -> int:
+    """Replay every corpus artifact; 0 = all reproduced bit-exactly, 2 = the
+    first drifting artifact (named on stderr AND in the summary line)."""
+    import glob
+
+    from raft_sim_tpu.scenario import shrink as shrink_mod
+
+    paths = sorted(glob.glob(os.path.join(directory, "*.json")))
+    if not paths:
+        print(json.dumps({"corpus": directory, "error": "no artifacts"}))
+        return 2
+    for path in paths:
+        name = os.path.basename(path)
+        art = shrink_mod.load_artifact(path)
+        res = shrink_mod.replay_artifact(art, context=0)
+        print(json.dumps({
+            "artifact": name,
+            "reproduced": res["reproduced"],
+            "tick": res["tick"],
+            "expected_tick": res["expected_tick"],
+            "kinds": res["kinds"],
+            "expected_kinds": res["expected_kinds"],
+            "mutant": art.get("mutant"),
+        }))
+        if not res["reproduced"]:
+            print(f"corpus DRIFT: {name} (expected tick "
+                  f"{res['expected_tick']} {res['expected_kinds']}, got "
+                  f"{res['tick']} {res['kinds']})", file=sys.stderr)
+            print(json.dumps({
+                "corpus": directory, "artifacts": len(paths),
+                "drifted": name,
+            }))
+            return 2
+    print(json.dumps({
+        "corpus": directory, "artifacts": len(paths), "reproduced": len(paths),
+    }))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="repro")
     ap.add_argument("--preset", choices=sorted(PRESETS), default=None)
@@ -166,10 +211,18 @@ def main(argv=None) -> int:
                     help="replay a scenario repro artifact instead of "
                          "shrinking a scalar-config run (exit 0 iff the "
                          "violation reproduces at the identical tick)")
+    ap.add_argument("--corpus", metavar="DIR", default=None,
+                    help="batch-replay every artifact in a corpus directory "
+                         "(tests/corpus); exit nonzero naming the first "
+                         "drifting artifact")
     from raft_sim_tpu.driver import _add_config_flags, build_config
 
     _add_config_flags(ap)
     args = ap.parse_args(argv)
+    if args.scenario and args.corpus:
+        ap.error("--scenario and --corpus are exclusive")
+    if args.corpus:
+        return replay_corpus(args.corpus)
     if args.scenario:
         return replay_scenario(args.scenario, args.context)
     if args.ticks is None:
