@@ -197,9 +197,10 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
     inv = set()
     if not cfg.pre_vote:
         inv |= {"mb.pv_grant"}
-        if not cfg.read_lease:
-            # heard_clock feeds the pre-vote quiet rule AND the lease vote
-            # denial: either gate keeps it live.
+        if not cfg.read_lease and not cfg.reconfig:
+            # heard_clock feeds the pre-vote quiet rule, the lease vote
+            # denial, AND the log-carried-config removed-server denial: any
+            # gate keeps it live.
             inv |= {"heard_clock"}
     if not cfg.compaction:
         inv |= {
@@ -222,7 +223,21 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
     # zero-cost-when-off contract the tentpole inherits from
     # track_offer_ticks/pre_vote/compaction.
     if not cfg.reconfig:
-        inv |= {"member_old", "member_new", "cfg_epoch", "cfg_pend"}
+        inv |= {
+            "member_old", "member_new", "cfg_epoch", "cfg_pend",
+            "log_cfg", "mb.ent_cfg",
+        }
+    if not (cfg.reconfig and cfg.compaction):
+        # The snapshot config context travels (and advances) only when both
+        # the config plane AND compaction are live.
+        inv |= {
+            "base_mold", "base_pend", "base_epoch",
+            "mb.req_base_mold", "mb.req_base_pend", "mb.req_base_epoch",
+        }
+    if not (cfg.leader_transfer and (cfg.reconfig or cfg.read_lease)):
+        # The disruptive-RequestVote override is written only when a denial
+        # gate exists to read it (transfer x [reconfig | lease]).
+        inv |= {"mb.req_disrupt"}
     if not cfg.leader_transfer:
         inv |= {"xfer_to", "mb.xfer_tgt"}
     if not cfg.read_index:

@@ -51,17 +51,18 @@ to [batch, ...] and `lax.scan` (sim/scan.py) rolls ticks.
 TRACE DELTA CONTRACT (raft_sim_tpu/trace, cfg.track_trace): the protocol
 trace plane derives discrete events from this kernel's state DELTAS --
 role, term, voted_for, commit_index, log_len, and (reconfiguration plane)
-cfg_epoch, xfer_to, read_idx -- outside the kernel (one extractor serves
-both kernels and any step_fn override; zero step lowerings added). Phase-
-order properties load-bearing for the whole-history checker, which must
+cfg_epoch, log_cfg, xfer_to, read_idx -- outside the kernel (one extractor
+serves both kernels and any step_fn override; zero step lowerings added).
+Phase-order properties load-bearing for the whole-history checker, which must
 survive refactors: (1) a node that loses leadership and accepts entries in
 one tick changes `role` in the SAME tick as `log_len` (phase 1 adoption
 precedes phase 3 append -- the checker replays role changes before log
 changes); (2) a win (phase 4) can never co-occur with an AE-accept
 truncation on the same node (a candidate that accepted a current-term AE
 stepped down in phase 3 and cannot win); (3) elections precede the
-phase-5.2 configuration transition, so EV_LEADER events belong to the
-TICK-START epoch (EV_EPOCH replays at end-of-tick); (4) a read slot dropped
+end-of-tick config derivation, so EV_LEADER events belong to the TICK-START
+per-node configuration (EV_CFG_APPLY/ROLLBACK replay after the role
+kinds); (4) a read slot dropped
 while its holder stays a same-term un-restarted leader was SERVED -- every
 cancel path changes role/term or sets `restarted` (phase 5.2's clear
 rules). See trace/events.py.
@@ -73,6 +74,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_sim_tpu.models import cfglog
 from raft_sim_tpu.ops import bitplane, log_ops
 from raft_sim_tpu.types import (
     CANDIDATE,
@@ -133,10 +135,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
-    if cfg.pre_vote or rdl:
+    if cfg.pre_vote or rdl or cfg.reconfig:
         # A restarted node remembers no leader contact: "quiet" immediately
-        # (pre-votes grantable, and -- under the lease gate -- real votes
-        # too: a restarted voter holds no lease obligation).
+        # (pre-votes grantable, and -- under the lease or log-carried-config
+        # denial gates -- real votes too: a restarted voter holds no
+        # obligation toward a leader it no longer remembers).
         s = s._replace(
             heard_clock=jnp.where(
                 rs, s.clock - cfg.election_min_ticks, s.heard_clock
@@ -157,27 +160,37 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
             s = s._replace(read_fr=jnp.where(rs, 0, s.read_fr))
     mb = s.mailbox
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk
-
-    # Reconfiguration plane (cfg.reconfig): configuration-masked quorums.
-    # member_old/member_new are cluster-scoped packed rows (ClusterState
-    # docstring); during a joint phase (cfg_pend > 0) every quorum test needs
-    # a majority of BOTH configurations -- the thesis-4.3 rule whose absence
-    # (cfg.joint_consensus False, TEST-ONLY mutant) is the classic one-step
-    # membership-change bug. Quorum tests below read the TICK-START
-    # configuration; the admin transition phase (5.2) applies changes for the
-    # next tick's tests but demotes removed leaders immediately.
     if rcf:
-        m_old, m_new = s.member_old, s.member_new  # [W]
-        joint = s.cfg_pend > 0  # scalar
-        maj_old = bitplane.count(m_old, axis=0) // 2 + 1  # scalar int32
-        maj_new = bitplane.count(m_new, axis=0) // 2 + 1
-        member_b = bitplane.unpack(m_old | m_new, n, axis=0)  # [N] bool
+        # Snapshot config context (compaction x reconfig; constant full-row /
+        # zero legs otherwise -- carried untouched when comp is off).
+        bmold, bpend, bepoch = s.base_mold, s.base_pend, s.base_epoch
+
+    # Reconfiguration plane (cfg.reconfig): log-carried, PER-NODE
+    # configuration masking. member_old/member_new/cfg_pend are each node's
+    # DERIVED view of its own log prefix (ClusterState docstring; the
+    # end-of-tick block recomputes them via models/cfglog.py), so every
+    # quorum test below masks by the TESTING NODE's own rows -- dual
+    # (majorities of BOTH configurations) while that node's prefix holds an
+    # uncompleted joint entry. Quorum tests read the TICK-START derivation;
+    # entries appended this tick govern the next (apply-on-append at tick
+    # granularity, the same one-tick rule every phase transition follows).
+    if rcf:
+        m_old, m_new = s.member_old, s.member_new  # [N, W]
+        joint = s.cfg_pend > 0  # [N]
+        maj_old = bitplane.count(m_old, axis=1) // 2 + 1  # [N] int32
+        maj_new = bitplane.count(m_new, axis=1) // 2 + 1
+        # Node i's own-membership bit: is i a voter of ITS OWN config union?
+        # A node whose log carries its removal quiesces (never campaigns);
+        # one whose log MISSES the removal still thinks it votes -- the
+        # removed-server disruption the 4.2.3 denial below defends against.
+        member_b = jnp.any(((m_old | m_new) & eye_p) != 0, axis=1)  # [N]
 
         def packed_quorum(rows):
-            """[N, W] packed grant rows -> [N] bool config-masked quorum."""
-            ok = bitplane.count(rows & m_old[None, :], axis=1) >= maj_old
+            """[N, W] packed grant rows (node i's banked grants) -> [N] bool
+            quorum under node i's OWN configuration(s)."""
+            ok = bitplane.count(rows & m_old, axis=1) >= maj_old
             return ok & (
-                ~joint | (bitplane.count(rows & m_new[None, :], axis=1) >= maj_new)
+                ~joint | (bitplane.count(rows & m_new, axis=1) >= maj_new)
             )
     else:
 
@@ -214,6 +227,24 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     req_in = deliver_req & (mb.req_type != 0)[:, None]  # [sender, receiver]
     resp_in = deliver_resp & (mb.resp_kind != 0)  # [receiver, responder]
 
+    # Heard-a-leader denial window (thesis 4.2.3), shared by the log-carried
+    # membership defense (rcf: a removed server whose log misses its removal
+    # still campaigns -- voters that heard a current leader recently must
+    # neither adopt its inflated term nor grant it votes) and the lease vote
+    # denial (rdl). Judged on the voter's LOCAL clock against the TICK-START
+    # heard_clock -- this tick's AppendEntries land in phase 3, after votes
+    # -- which only SHORTENS the window by one tick (the lease validator's
+    # +4 slack covers it; docs/PROTOCOL.md). The disruptive-RequestVote
+    # override (req_disrupt, set on transfer-triggered elections) bypasses
+    # the denial: the leader being replaced sanctioned that election, so
+    # denying it would deadlock every TimeoutNow transfer.
+    if rcf or rdl:
+        heard_recent = (s.clock + inp.skew) - s.heard_clock < cfg.election_min_ticks
+        if xfr:
+            rv_denied = heard_recent[None, :] & ~(mb.req_disrupt != 0)[:, None]
+        else:
+            rv_denied = jnp.broadcast_to(heard_recent[None, :], (n, n))
+
     # ---- phase 1: term adoption --------------------------------------------------
     # Spec: any RPC (request or response) with term T > currentTerm -> set
     # currentTerm = T, convert to follower. The reference does this for responses
@@ -223,6 +254,13 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         term_req = req_in & (mb.req_type != REQ_PREVOTE)[:, None]
     else:
         term_req = req_in
+    if rcf:
+        # 4.2.3 in full: a denied RequestVote is not PROCESSED -- its term is
+        # not adopted either, so a removed server's inflated term cannot
+        # depose a live leader through its own voters (the disruption
+        # defense; under rdl alone the PR-11 grant-only denial is kept
+        # bit-for-bit -- adoption stays legal there).
+        term_req = term_req & ~((mb.req_type == REQ_VOTE)[:, None] & rv_denied)
     in_term = jnp.maximum(
         jnp.max(jnp.where(term_req, mb.req_term[:, None], 0), axis=0),
         jnp.max(jnp.where(resp_in, mb.resp_term[None, :], 0), axis=1),
@@ -250,19 +288,17 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         & (mb.req_last_index[:, None] >= my_last_idx[None, :])
     )
     can_grant = cur_rv & up_to_date
-    if rdl:
-        # Lease vote denial (thesis 4.2.3, the rule 6.4.1's lease leans on):
-        # a voter that heard from a current leader within the minimum
-        # election timeout on its LOCAL clock denies RequestVote outright --
-        # so a leader whose heartbeats a quorum acked L ticks ago KNOWS no
-        # election can complete for election_min_ticks/2 more global ticks
-        # (local clocks advance at most 2/tick under skew; the config
-        # validator pins the lease term under that bound). Judged against
-        # the TICK-START heard_clock -- this tick's AppendEntries land in
-        # phase 3, after votes -- which only SHORTENS the denial window by
-        # one tick; the validator's +4 slack covers it (docs/PROTOCOL.md).
-        lease_quiet = (s.clock + inp.skew) - s.heard_clock < cfg.election_min_ticks
-        can_grant = can_grant & ~lease_quiet[None, :]
+    if rcf or rdl:
+        # Heard-a-leader vote denial (thesis 4.2.3; the shared window above):
+        # under the lease gate this is the rule 6.4.1 leans on -- a leader
+        # whose heartbeats a quorum acked L ticks ago KNOWS no election can
+        # complete for election_min_ticks/2 more global ticks (local clocks
+        # advance at most 2/tick under skew; the config validator pins the
+        # lease term under that bound). Under the log-carried membership
+        # plane it is the removed-server disruption defense. The transfer
+        # override (rv_denied folds in req_disrupt) lets TimeoutNow
+        # elections through either way.
+        can_grant = can_grant & ~rv_denied
     # At most one grant per node per tick: the lowest eligible candidate id wins the
     # race (the reference serializes naturally, one message per wait iteration).
     lowest = jnp.min(jnp.where(can_grant, snd_ids, n), axis=0)  # [N], n = none
@@ -310,6 +346,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     w_term = mb.ent_term[sel_idx]  # [N, E]
     w_val = mb.ent_val[sel_idx]
     w_tick = mb.ent_tick[sel_idx] if track else None
+    w_cfg = mb.ent_cfg[sel_idx] if rcf else None
     prev_i = jnp.where(ae_norm, ws_in + j_nn, 0)
     lcommit = jnp.where(ae_norm, mb.req_commit[sel_idx], 0)
     n_ent = jnp.where(ae_norm, jnp.clip(mb.ent_count[sel_idx] - j_nn, 0, e), 0)
@@ -322,6 +359,7 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     ent_term_in = log_ops.window(w_term, off, e)  # [N, E]
     ent_val_in = log_ops.window(w_val, off, e)
     ent_tick_in = log_ops.window(w_tick, off, e) if track else None
+    ent_cfg_in = log_ops.window(w_cfg, off, e) if rcf else None
 
     # A valid AE from the current term makes candidates (and pre-candidates)
     # step down and identifies the leader (core.clj:121-123, minus the :follwer
@@ -393,6 +431,15 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         log_tick_arr = wwr(s.log_tick, prev_i, ent_tick_in, wmask)
     else:
         log_tick_arr = s.log_tick  # untouched: loop-invariant carry leg
+    # The config-entry plane replicates under the SAME masks: non-config
+    # entries ship 0, so an accepted window scrubs any stale config command
+    # off the slots it overwrites (the rollback hazard the derivation
+    # depends on -- ClusterState.log_cfg docstring).
+    if rcf:
+        wwc = log_ops.write_window_r if comp else log_ops.write_window
+        log_cfg_arr = wwc(s.log_cfg, prev_i, ent_cfg_in, wmask)
+    else:
+        log_cfg_arr = s.log_cfg  # untouched: loop-invariant carry leg
 
     # Follower commit: min(leaderCommit, index of last new entry), monotonic
     # (the reference's apply-entries! commits everything unconditionally, bug 2.3.6).
@@ -424,6 +471,15 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         base = jnp.where(apply_snap, L, base)
         log_len = jnp.where(wipe, L, log_len)
         commit = jnp.where(apply_snap, jnp.maximum(commit, L), commit)
+        if rcf:
+            # The snapshot carries its configuration context: the sender's
+            # C_old/pending-toggle/entry-count at L, so the receiver's
+            # derivation stays exact over config entries it never saw.
+            bmold = jnp.where(
+                apply_snap[:, None], mb.req_base_mold[sel_idx], bmold
+            )
+            bpend = jnp.where(apply_snap, mb.req_base_pend[sel_idx], bpend)
+            bepoch = jnp.where(apply_snap, mb.req_base_epoch[sel_idx], bepoch)
     else:
         apply_snap = jnp.zeros((n,), bool)
 
@@ -456,10 +512,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     # and we are QUIET: not a leader ourselves and no valid AppendEntries
     # accepted within the minimum election timeout (including this tick's).
     # Grants are non-binding: no votedFor, no term change, no timer reset.
-    if cfg.pre_vote or rdl:
-        # heard_clock maintenance serves two consumers: the pre-vote quiet
-        # rule (below) and the lease vote denial (phase 2) -- either gate
-        # keeps the leg live.
+    if cfg.pre_vote or rdl or rcf:
+        # heard_clock maintenance serves three consumers: the pre-vote quiet
+        # rule (below), the lease vote denial, and the log-carried-config
+        # removed-server denial (both phase 2) -- any gate keeps the leg
+        # live.
         clock_pv = s.clock + inp.skew  # phase 7's clock; duplicated, CSE'd
         heard = jnp.where(has_ae, clock_pv, s.heard_clock)  # [N]
     else:
@@ -596,23 +653,26 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     is_leader = role == LEADER
     match_with_self = jnp.where(eye, log_len[:, None], match_index)  # [N, N]
     if rcf:
-        # Configuration-masked quorum match: the largest replicated index v
-        # such that a majority of the config's members have match >= v. The
-        # quorum-th order statistic of a multiset is an element of it, so
-        # candidates range over the members' own match values (count form --
-        # the member majority is traced data, so the static sort-and-index
-        # form cannot apply). During joint: the min over both configs (an
-        # index is committed only when replicated to majorities of BOTH).
+        # Configuration-masked quorum match under EACH LEADER's OWN derived
+        # configuration: the largest replicated index v such that a majority
+        # of that leader's member rows have match >= v. The quorum-th order
+        # statistic of a multiset is an element of it, so candidates range
+        # over the members' own match values (count form -- the member
+        # majority is traced data, so the static sort-and-index form cannot
+        # apply). While the leader's prefix is joint: the min over both its
+        # configs (an index commits only when replicated to majorities of
+        # BOTH).
         mws = match_with_self
         ge = mws[:, None, :] >= mws[:, :, None]  # [i, j(candidate), k(counted)]
 
         def masked_qmatch(mask_b, maj):
-            cnt = jnp.sum(ge & mask_b[None, None, :], axis=2)  # [N, N]
-            ok = (cnt >= maj) & mask_b[None, :]
+            # mask_b [N(i), N(k)]: node i's member view; maj [N(i)].
+            cnt = jnp.sum(ge & mask_b[:, None, :], axis=2)  # [N, N]
+            ok = (cnt >= maj[:, None]) & mask_b
             return jnp.max(jnp.where(ok, mws, 0), axis=1).astype(jnp.int32)
 
-        mem_old_b = bitplane.unpack(m_old, n, axis=0)  # [N] bool
-        mem_new_b = bitplane.unpack(m_new, n, axis=0)
+        mem_old_b = bitplane.unpack(m_old, n, axis=1)  # [N, N] bool
+        mem_new_b = bitplane.unpack(m_new, n, axis=1)
         qm_old = masked_qmatch(mem_old_b, maj_old)
         quorum_match = jnp.where(
             joint, jnp.minimum(qm_old, masked_qmatch(mem_new_b, maj_new)), qm_old
@@ -631,67 +691,13 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         commit,
     )
 
-    # ---- phase 5.2: reconfiguration admin ----------------------------------------
-    # Membership transitions (cfg.reconfig): joint exit, then command accept,
-    # then removed-leader stepdown. Quorum tests this tick already ran on the
-    # tick-start configuration; transitions below govern the NEXT tick's
-    # quorums -- except stepdown, which is immediate (a leader voted out of
-    # both configurations must not finish the tick with authority: it would
-    # heartbeat, inject, and commit from outside the voting set).
-    if rcf:
-        # Exit the joint phase once a live member leader's commit covers the
-        # change point: everything through cfg_pend - 1 is replicated under
-        # the DUAL quorum, so the new configuration's majority holds the
-        # whole committed prefix and C_new can take over alone (thesis 4.3's
-        # C_old,new-committed condition in this model's admin terms).
-        exit_j = joint & jnp.any(
-            is_leader & inp.alive & member_b & (commit >= s.cfg_pend - 1)
-        )
-        m_old2 = jnp.where(exit_j, m_new, m_old)
-        cfg_pend = jnp.where(exit_j, 0, s.cfg_pend)
-        cfg_epoch = s.cfg_epoch + exit_j
-        joint2 = cfg_pend > 0
-        # Accept a membership toggle: owned by the lowest-id live member
-        # leader (the admin's POST target), refused while a joint phase is
-        # pending, and refused when the toggle would leave < 2 voters.
-        memb_mid = bitplane.unpack(m_old2 | m_new, n, axis=0)
-        ld_ok = is_leader & inp.alive & memb_mid
-        ld = jnp.min(jnp.where(ld_ok, ids, n))
-        t_r = inp.reconfig_cmd
-        tbit = bitplane.one_bit(t_r, n)  # [W]; all-zero row for NIL
-        toggled = m_new ^ tbit
-        accept = (
-            (t_r != NIL)
-            & ~joint2
-            & (ld < n)
-            & (bitplane.count(tbit, axis=0) > 0)
-            & (bitplane.count(toggled, axis=0) >= 2)
-        )
-        ld_len = log_len[jnp.minimum(ld, n - 1)]
-        if cfg.joint_consensus:
-            # Enter the joint phase: C_new diverges, quorums go dual next
-            # tick, and the exit bound is the owning leader's current log
-            # frontier + 1 (exit once commit reaches it).
-            m_new2 = jnp.where(accept, toggled, m_new)
-            m_old3 = m_old2
-            cfg_pend = jnp.where(accept, ld_len + 1, cfg_pend)
-        else:
-            # TEST-ONLY mutant (cfg.joint_consensus False): the one-step
-            # membership change -- both configurations switch instantly, no
-            # joint phase, so consecutive changes can produce old/new
-            # majorities that do not intersect (the thesis-4.3 bug the CE
-            # hunt must re-find).
-            m_new2 = jnp.where(accept, toggled, m_new)
-            m_old3 = jnp.where(accept, toggled, m_old2)
-        cfg_epoch = cfg_epoch + accept
-        # Removed-leader stepdown ("non-voting catch-up": the node stays
-        # simulated -- it keeps receiving entries as a learner -- but holds
-        # no role and, via the phase-7 membership gate, never campaigns).
-        member_b2 = bitplane.unpack(m_old3 | m_new2, n, axis=0)
-        demote = ~member_b2 & (role != FOLLOWER)
-        role = jnp.where(demote, FOLLOWER, role)
-        leader_id = jnp.where(demote, NIL, leader_id)
-        is_leader = role == LEADER
+    # ---- phase 5.2: reconfiguration transitions moved INTO the log --------------
+    # (Log-carried membership: there is no admin transition block anymore.
+    # Joint entry/exit are LOG APPENDS -- phase 6 originates them on the
+    # leader, phase 3 replicates them -- and each node's effective
+    # configuration is re-derived from its own prefix at end of tick
+    # (models/cfglog.py), which is also where removed-leader stepdown and
+    # the truncation rollback live.)
     # Leadership-transfer bookkeeping (cfg.leader_transfer): abort a pending
     # transfer whose holder lost leadership or whose target went unresponsive
     # (ack_age horizon -- a dead target must not freeze the write path), then
@@ -706,9 +712,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         t_x = inp.transfer_cmd
         ld_ok_x = is_leader & inp.alive
         if rcf:
-            ld_ok_x = ld_ok_x & member_b2
-            # The target must be a voter of the target configuration.
-            t_voter = jnp.any((m_new2 & bitplane.one_bit(t_x, n)) != 0)
+            ld_ok_x = ld_ok_x & member_b
+            # The target must be a voter of the LEADER's own target config
+            # (per-node derived rows; tick-start like every config read).
+            t_voter = jnp.any((m_new & bitplane.one_bit(t_x, n)[None, :]) != 0, axis=1)
         else:
             t_voter = jnp.bool_(True)
         ldx = jnp.min(jnp.where(ld_ok_x, ids, n))
@@ -759,6 +766,13 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
             )
             fresh_p = bitplane.pack(ack_age <= lease_w, axis=1)  # [N, W]
             lease_ok = packed_quorum(fresh_p | eye_p)
+            if xfr:
+                # Transfer handoff covers the read path: once a transfer
+                # pends, the lease fast path stops -- the target's override
+                # election (req_disrupt) bypasses the 4.2.3 denial the lease
+                # bound leans on, so only reads served BEFORE the handoff
+                # may lean on it (docs/PROTOCOL.md staleness argument).
+                lease_ok = lease_ok & ~xfer_pend
             serve = serve | (keep_r & inp.alive & lease_ok)
         lat_r = jnp.maximum(s.now + 1 - s.read_tick, 1)  # [N]
         reads_served = jnp.sum(serve).astype(jnp.int32)
@@ -882,6 +896,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         target = jnp.minimum(commit, log_len - (cap - cfg.compact_margin))
         base2 = jnp.maximum(base, target)
         bterm = log_ops.term_at_r(log_term_arr, base, bterm, base2)  # = bterm if unchanged
+        if rcf:
+            # Fold the compacted span's config entries into the snapshot
+            # context (cfglog.fold_span; anchored at the PRE-advance base,
+            # same aliasing rule as the checksum pass below -- must run
+            # before phase 6 can reuse freed slots).
+            bmold, bpend, bepoch = cfglog.fold_span(
+                cfg, log_cfg_arr, base, base2, bmold, bpend, bepoch
+            )
         base = base2
 
     # ---- committed-prefix checksum --------------------------------------------------
@@ -938,6 +960,46 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         noop = jnp.zeros((n,), bool)
         room = log_len - base < cap
         noop_blocked = jnp.int32(0)
+    # ---- config-entry origination (log-carried membership, thesis 4.3) ----------
+    # Config changes are LOG WRITES sharing phase 6's one-append-per-node
+    # slot (priority: election no-op > config entry > client command), each
+    # judged on the leader's OWN tick-start derived configuration:
+    #   JOINT entry (+v+1): the admin's toggle, accepted by the lowest-id
+    #   live voter-leader, refused while that leader's prefix is already
+    #   joint or when the toggle would leave C_new below 2 voters.
+    #   FINAL entry (-v-1): appended automatically once the governing joint
+    #   entry commits on the leader (commit >= cfg_pend) -- the thesis's
+    #   "C_old,new committed -> append C_new" step.
+    if rcf:
+        t_r = inp.reconfig_cmd
+        tbit = bitplane.one_bit(t_r, n)  # [W]; all-zero row for NIL
+        toggled = m_new ^ tbit[None, :]  # [N, W]: each node's view of the result
+        ld_ok = is_leader & inp.alive & member_b & room & ~noop
+        ldj = jnp.min(jnp.where(ld_ok & ~joint, ids, n))
+        accept_j = (
+            (t_r != NIL)
+            & (ids == ldj)
+            & ld_ok
+            & ~joint
+            & (bitplane.count(tbit, axis=0) > 0)
+            & (bitplane.count(toggled, axis=1) >= 2)
+        )
+        if cfg.joint_consensus:
+            # Pending toggle of this node's open joint phase: the one bit
+            # its member_old and member_new rows differ on.
+            pvbits = bitplane.unpack(m_old ^ m_new, n, axis=1)  # [N, N]
+            pend_v = jnp.min(jnp.where(pvbits, ids[None, :], n), axis=1)
+            accept_f = ld_ok & joint & (commit >= s.cfg_pend)
+            cfg_code = jnp.where(
+                accept_j, t_r + 1, jnp.where(accept_f, -(pend_v + 1), 0)
+            ).astype(jnp.int32)
+            cfg_write = accept_j | accept_f
+        else:
+            # TEST-ONLY mutant (single-server change, cfg.joint_consensus
+            # False): one final-acting entry per change, no joint phase, no
+            # completing entry -- the known-unsafe variant.
+            cfg_code = jnp.where(accept_j, t_r + 1, 0).astype(jnp.int32)
+            cfg_write = accept_j
     if cfg.client_redirect:
         # K commands in flight (cfg.client_pipeline -- the reference's
         # buffered(5) request channel, server.clj:37): a fresh offer takes the
@@ -960,6 +1022,8 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         tgt_oh = active[:, None] & (tgt[:, None] == ids[None, :])  # [K, N]
         low_k = jnp.min(jnp.where(tgt_oh, kk[:, None], kdim), axis=0)  # [N]
         node_ok = is_leader & inp.alive & room & ~noop
+        if rcf:
+            node_ok = node_ok & ~cfg_write  # the slot holds a config entry
         if xfr:
             # Transfer lease handoff (thesis 3.10): a transferring leader
             # stops accepting client commands until the transfer completes
@@ -988,6 +1052,8 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         client_tick = jnp.where(pend_on, ptick, 0) if track else s.client_tick
     else:
         client_ok = (inp.client_cmd != NIL) & is_leader & inp.alive & room & ~noop
+        if rcf:
+            client_ok = client_ok & ~cfg_write  # the slot holds a config entry
         if xfr:
             client_ok = client_ok & ~xfer_pend  # transfer lease handoff
         wval_cl = jnp.broadcast_to(inp.client_cmd, (n,))
@@ -1001,8 +1067,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         client_pend = s.client_pend
         client_dst = s.client_dst
         client_tick = s.client_tick
-    do_write = noop | client_ok
+    do_write = (noop | cfg_write | client_ok) if rcf else (noop | client_ok)
     wval = jnp.where(noop, NOOP, wval_cl)
+    if rcf:
+        # Config entries carry value 0 (the command rides the log_cfg plane).
+        wval = jnp.where(cfg_write, 0, wval)
     inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)
     log_term_arr = log_term_arr.at[ids, inj_pos].set(term, mode="drop")
     log_val_arr = log_val_arr.at[ids, inj_pos].set(
@@ -1011,8 +1080,16 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
     if track:
         # No-op entries carry stamp 0: protocol filler, never a client offer.
         wtick = jnp.where(noop, 0, wtick_cl)
+        if rcf:
+            wtick = jnp.where(cfg_write, 0, wtick)  # config entries too
         log_tick_arr = log_tick_arr.at[ids, inj_pos].set(
             jnp.broadcast_to(wtick, (n,)), mode="drop"
+        )
+    if rcf:
+        # EVERY append writes the config plane (0 for non-config entries):
+        # a slot reused after truncation must never leak its old command.
+        log_cfg_arr = log_cfg_arr.at[ids, inj_pos].set(
+            jnp.where(cfg_write, cfg_code, 0), mode="drop"
         )
     log_len = log_len + do_write
 
@@ -1041,9 +1118,11 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         # The REAL election start is this tick's promotions (phase 4.5).
         start_prevote = expired & ~is_leader
         if rcf:
-            # Non-voters never campaign (the removed-node quiescence rule:
-            # a node outside both configurations is a learner).
-            start_prevote = start_prevote & member_b2
+            # Non-voters never campaign (the removed-node quiescence rule,
+            # judged on the node's OWN derived config: a node whose log
+            # carries its removal is a learner; one whose log misses it
+            # still campaigns -- the disruption the 4.2.3 denial absorbs).
+            start_prevote = start_prevote & member_b
         if xfr:
             # A TimeoutNow target skips the probe: its real election (below)
             # is the thesis-3.10 pre-vote bypass.
@@ -1070,11 +1149,12 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         start_prevote = jnp.zeros((n,), bool)
         start_election = expired & ~is_leader
         if rcf:
-            start_election = start_election & member_b2  # non-voters never campaign
+            start_election = start_election & member_b  # non-voters never campaign
         if xfr:
             # TimeoutNow election (~is_leader re-checked: the target may have
             # won an ordinary election in phase 4 this very tick).
-            start_election = start_election | (xfer_elect & ~is_leader)
+            xe = xfer_elect & ~is_leader
+            start_election = start_election | xe
         term = term + start_election
         role = jnp.where(start_election, CANDIDATE, role)
         voted_for = jnp.where(start_election, ids, voted_for)
@@ -1130,6 +1210,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         out_xfer_tgt = jnp.where(fire, xfer_to, NIL).astype(jnp.int8)
     else:
         out_xfer_tgt = mb.xfer_tgt  # NIL, loop-invariant carry component
+    if xfr and (rcf or rdl):
+        # The disruptive-RequestVote override (thesis 3.10/4.2.3): a
+        # transfer-triggered election's broadcast carries the flag, so
+        # heard-recent voters still process it. Written only when a denial
+        # gate can read it; zeros and carried untouched otherwise.
+        out_req_disrupt = jnp.where(xe, 1, 0).astype(jnp.int8)
+    else:
+        out_req_disrupt = mb.req_disrupt  # zeros, loop-invariant component
     # AE: prev = nextIndex - 1 per edge, carried as the offset into the shared window.
     prev_out = jnp.clip(next_index - 1, 0, log_len[:, None])  # [src, dst]
     # Shared window start: minimum prev over RESPONSIVE peers (acked an AE within
@@ -1180,6 +1268,10 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         jnp.where(ship_used, wread(log_tick_arr, ws, e), 0) if track
         else mb.ent_tick  # zeros, loop-invariant carry component
     )
+    out_ent_cfg = (
+        jnp.where(ship_used, wread(log_cfg_arr, ws, e), 0) if rcf
+        else mb.ent_cfg  # zeros, loop-invariant carry component
+    )
 
     # Responses: vr_out/ar_out are [request-sender, request-receiver], which IS the
     # response orientation [response-receiver, responder] (the reference's resp-chan
@@ -1224,6 +1316,20 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
             jnp.where(send_append, bchk, jnp.uint32(0)) if comp else mb.req_base_chk
         ),
         xfer_tgt=out_xfer_tgt,
+        req_disrupt=out_req_disrupt,
+        ent_cfg=out_ent_cfg,
+        req_base_mold=(
+            jnp.where(send_append[:, None], bmold, jnp.uint32(0))
+            if (comp and rcf) else mb.req_base_mold
+        ),
+        req_base_pend=(
+            jnp.where(send_append, bpend, 0) if (comp and rcf)
+            else mb.req_base_pend
+        ),
+        req_base_epoch=(
+            jnp.where(send_append, bepoch, 0) if (comp and rcf)
+            else mb.req_base_epoch
+        ),
         req_off=out_req_off,
         resp_kind=out_resp_kind,
         pv_grant=out_pv_grant,
@@ -1233,6 +1339,40 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         a_hint=out_a_hint,
         resp_term=term,
     )
+
+    # ---- end-of-tick config derivation (log-carried membership) ------------------
+    # Each node's effective configuration recomputed from its post-append,
+    # post-compaction log prefix (models/cfglog.py): apply-on-append and
+    # roll-back-on-truncation are the SAME recomputation -- a truncated
+    # config entry simply stops existing for the next tick's quorums.
+    if rcf:
+        # base_mold/base_pend/base_epoch initialize to the boot config
+        # (types.init_state) and are carried untouched without compaction,
+        # so they are always the valid context at `base`.
+        d_mold, d_mnew, d_pend, d_epoch, d_hi = cfglog.derive(
+            cfg, log_cfg_arr, log_len, commit, base, bmold, bpend, bepoch
+        )
+        if not cfg.truncation_rollback:
+            # TEST-ONLY mutant (ignore-truncation-rollback): where the
+            # prefix LOST config entries, keep acting on the stale carried
+            # configuration -- the dissertation's rollback rule skipped.
+            rolled = d_epoch < s.cfg_epoch
+            d_mold = jnp.where(rolled[:, None], s.member_old, d_mold)
+            d_mnew = jnp.where(rolled[:, None], s.member_new, d_mnew)
+            d_pend = jnp.where(rolled, s.cfg_pend, d_pend)
+            d_epoch = jnp.where(rolled, s.cfg_epoch, d_epoch)
+        # Removed-server stepdown (thesis 4.3): a LEADER whose own config
+        # union excludes it keeps leading -- replicating the very entry
+        # that removes it -- until that entry commits on it, then steps
+        # down (its log never counts toward masked quorums meanwhile: the
+        # caretaker role). Candidacies of removed nodes die immediately.
+        self_in = jnp.any(((d_mold | d_mnew) & eye_p) != 0, axis=1)
+        is_cand = (role == CANDIDATE) | (role == PRECANDIDATE)
+        demote = ~self_in & (
+            ((role == LEADER) & (commit >= d_hi)) | is_cand
+        )
+        role = jnp.where(demote, FOLLOWER, role)
+        leader_id = jnp.where(demote, NIL, leader_id)
 
     new_state = ClusterState(
         role=role,
@@ -1255,10 +1395,14 @@ def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterStat
         clock=clock,
         deadline=deadline,
         heard_clock=heard,
-        member_old=m_old3 if rcf else s.member_old,
-        member_new=m_new2 if rcf else s.member_new,
-        cfg_epoch=cfg_epoch if rcf else s.cfg_epoch,
-        cfg_pend=cfg_pend if rcf else s.cfg_pend,
+        member_old=d_mold if rcf else s.member_old,
+        member_new=d_mnew if rcf else s.member_new,
+        cfg_epoch=d_epoch if rcf else s.cfg_epoch,
+        cfg_pend=d_pend if rcf else s.cfg_pend,
+        log_cfg=log_cfg_arr,
+        base_mold=bmold if (rcf and comp) else s.base_mold,
+        base_pend=bpend if (rcf and comp) else s.base_pend,
+        base_epoch=bepoch if (rcf and comp) else s.base_epoch,
         xfer_to=xfer_to if xfr else s.xfer_to,
         read_idx=read_idx if rdx else s.read_idx,
         read_tick=read_tick if rdx else s.read_tick,

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_sim_tpu.models import cfglog
 from raft_sim_tpu.ops import bitplane, log_ops
 from raft_sim_tpu.types import (
     CANDIDATE,
@@ -97,10 +98,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
-    if cfg.pre_vote or rdl:
+    if cfg.pre_vote or rdl or rcf:
         # A restarted node remembers no leader contact: "quiet" immediately
-        # (pre-votes grantable, and -- under the lease gate -- real votes
-        # too: a restarted voter holds no lease obligation).
+        # (pre-votes grantable, and -- under the lease or log-carried-config
+        # denial gates -- real votes too: raft.py phase -1).
         s = s._replace(
             heard_clock=jnp.where(
                 rs, s.clock - cfg.election_min_ticks, s.heard_clock
@@ -121,23 +122,28 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             s = s._replace(read_fr=jnp.where(rs, 0, s.read_fr))
     mb = s.mailbox
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk  # [N, B]
-
-    # Reconfiguration plane: configuration-masked quorums (raft.py). Masks
-    # are cluster-scoped [W, B] rows; tests read the TICK-START configuration
-    # (phase 5.2 applies transitions for the next tick, demotions aside).
     if rcf:
-        m_old, m_new = s.member_old, s.member_new  # [W, B]
-        joint = s.cfg_pend > 0  # [B]
-        maj_old = bitplane.count(m_old, axis=0) // 2 + 1  # [B]
-        maj_new = bitplane.count(m_new, axis=0) // 2 + 1
-        member_b = bitplane.unpack(m_old | m_new, n, axis=0)  # [N, B]
+        # Snapshot config context (raft.py): carried untouched without comp.
+        bmold, bpend, bepoch = s.base_mold, s.base_pend, s.base_epoch
+
+    # Reconfiguration plane: log-carried, PER-NODE configuration masking
+    # (raft.py): member rows are each node's derived view of its own log
+    # prefix, [N, W, B]; every quorum test masks by the TESTING node's rows,
+    # dual while that node's cfg_pend marks an open joint entry.
+    if rcf:
+        m_old, m_new = s.member_old, s.member_new  # [N, W, B]
+        joint = s.cfg_pend > 0  # [N, B]
+        maj_old = bitplane.count(m_old, axis=1) // 2 + 1  # [N, B]
+        maj_new = bitplane.count(m_new, axis=1) // 2 + 1
+        # Node i's own-membership bit (raft.py: the removed-server
+        # disruption surface when a log misses its removal entry).
+        member_b = jnp.any(((m_old | m_new) & eye_p3) != 0, axis=1)  # [N, B]
 
         def packed_quorum(rows):
-            """[N, W, B] packed grant rows -> [N, B] config-masked quorum."""
-            ok = bitplane.count(rows & m_old[None], axis=1) >= maj_old[None, :]
+            """[N, W, B] packed grant rows -> [N, B] own-config quorum."""
+            ok = bitplane.count(rows & m_old, axis=1) >= maj_old
             return ok & (
-                ~joint[None, :]
-                | (bitplane.count(rows & m_new[None], axis=1) >= maj_new[None, :])
+                ~joint | (bitplane.count(rows & m_new, axis=1) >= maj_new)
             )
     else:
 
@@ -166,12 +172,32 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     req_in = deliver_req & (mb.req_type != 0)[:, None, :]
     resp_in = deliver_resp & (mb.resp_kind != 0)
 
+    # Heard-a-leader denial window (thesis 4.2.3; raft.py for the full
+    # argument): shared by the log-carried membership defense (rcf) and the
+    # lease vote denial (rdl), bypassed by the transfer override flag.
+    if rcf or rdl:
+        heard_recent = (
+            (s.clock + inp.skew) - s.heard_clock < cfg.election_min_ticks
+        )  # [N, B]
+        if xfr:
+            rv_denied = (
+                heard_recent[None, :, :] & ~(mb.req_disrupt != 0)[:, None, :]
+            )
+        else:
+            rv_denied = jnp.broadcast_to(heard_recent[None, :, :], (n, n, b))
+
     # ---- phase 1: term adoption (PreVote probes carry a PROSPECTIVE term:
     # never adopted -- raft.py phase 1) -------------------------------------------
     if cfg.pre_vote:
         term_req = req_in & (mb.req_type != REQ_PREVOTE)[:, None, :]
     else:
         term_req = req_in
+    if rcf:
+        # 4.2.3 in full: denied RequestVotes are not PROCESSED -- no term
+        # adoption either (the removed-server disruption defense; raft.py).
+        term_req = term_req & ~(
+            (mb.req_type == REQ_VOTE)[:, None, :] & rv_denied
+        )
     in_term = jnp.maximum(
         jnp.max(jnp.where(term_req, mb.req_term[:, None, :], 0), axis=0),
         jnp.max(jnp.where(resp_in, mb.resp_term[None, :, :], 0), axis=1),
@@ -197,14 +223,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         & (mb.req_last_index[:, None, :] >= my_last_idx[None, :, :])
     )
     can_grant = cur_rv & up_to_date
-    if rdl:
-        # Lease vote denial (thesis 4.2.3; raft.py phase 2 for the full
-        # staleness argument): deny while a current leader was heard within
-        # the minimum election timeout on the voter's LOCAL clock.
-        lease_quiet = (
-            (s.clock + inp.skew) - s.heard_clock < cfg.election_min_ticks
-        )  # [N, B]
-        can_grant = can_grant & ~lease_quiet[None, :, :]
+    if rcf or rdl:
+        # Heard-a-leader vote denial (thesis 4.2.3; raft.py phase 2), with
+        # the transfer override folded into rv_denied.
+        can_grant = can_grant & ~rv_denied
     lowest = jnp.min(jnp.where(can_grant, snd_ids, n), axis=0)  # [N, B]
     # Boolean arithmetic instead of where-on-bools: Mosaic cannot lower vector
     # selects with i1 operands.
@@ -245,14 +267,24 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     prev_i = jnp.where(ae_norm, ws_in + j_nn, 0)
     n_ent = jnp.where(ae_norm, jnp.clip(pick_h(mb.ent_count) - j_nn, 0, e), 0)
     # One masked reduction selects EVERY window plane (same one-hot mask):
-    # terms and values -- plus offer stamps when the tick plane is live --
-    # ride a single [N, N, (2|3)E, B] pass, split after.
-    planes = [mb.ent_term, mb.ent_val] + ([mb.ent_tick] if track else [])
-    ent_tv = jnp.concatenate(planes, axis=1)  # [N, (2|3)E, B]
+    # terms and values -- plus offer stamps / config commands when their
+    # planes are live -- ride a single [N, N, kE, B] pass, split after.
+    planes = [mb.ent_term, mb.ent_val]
+    if track:
+        planes.append(mb.ent_tick)
+    if rcf:
+        planes.append(mb.ent_cfg)
+    ent_tv = jnp.concatenate(planes, axis=1)  # [N, kE, B]
     w_tv = jnp.sum(jnp.where(sel[:, :, None, :], ent_tv[:, None], 0), axis=0)
     w_term_in = w_tv[:, :e]  # [N, E, B]
     w_val_in = w_tv[:, e:2 * e]
-    w_tick_in = w_tv[:, 2 * e:] if track else None
+    off_w = 2 * e
+    if track:
+        w_tick_in = w_tv[:, off_w:off_w + e]
+        off_w += e
+    else:
+        w_tick_in = None
+    w_cfg_in = w_tv[:, off_w:off_w + e] if rcf else None
     # prev term via ext[k] = term of 1-based entry ws+k: k=0 is the sender's
     # ent_prev_term, k>=1 the shared window slots; one-hot over the E+1 offsets.
     ext = jnp.concatenate(
@@ -265,6 +297,7 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     ent_term_in = log_ops.window_b(w_term_in, off, e)  # [N, E, B]
     ent_val_in = log_ops.window_b(w_val_in, off, e)
     ent_tick_in = log_ops.window_b(w_tick_in, off, e) if track else None
+    ent_cfg_in = log_ops.window_b(w_cfg_in, off, e) if rcf else None
 
     if cfg.pre_vote:
         stepdown = (role == CANDIDATE) | (role == PRECANDIDATE)
@@ -320,6 +353,12 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             log_tick_arr = log_ops.write_window_rb(
                 s.log_tick, prev_i, ent_tick_in, ae_ok, lo, n_acc
             )
+        if rcf:
+            # Same masks as the value plane: non-config entries ship 0 and
+            # scrub stale config commands off reused slots (raft.py).
+            log_cfg_arr = log_ops.write_window_rb(
+                s.log_cfg, prev_i, ent_cfg_in, ae_ok, lo, n_acc
+            )
     else:
         log_term_arr = log_ops.write_window_b(s.log_term, prev_i, ent_term_in, ae_ok, n_ent)
         log_val_arr = log_ops.write_window_b(s.log_val, prev_i, ent_val_in, ae_ok, n_ent)
@@ -327,8 +366,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             log_tick_arr = log_ops.write_window_b(
                 s.log_tick, prev_i, ent_tick_in, ae_ok, n_ent
             )
+        if rcf:
+            log_cfg_arr = log_ops.write_window_b(
+                s.log_cfg, prev_i, ent_cfg_in, ae_ok, n_ent
+            )
     if not track:
         log_tick_arr = s.log_tick  # untouched: loop-invariant carry leg
+    if not rcf:
+        log_cfg_arr = s.log_cfg  # untouched: loop-invariant carry leg
 
     last_new = jnp.minimum(prev_i + n_acc, log_len)
     commit = jnp.where(
@@ -355,6 +400,17 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         base = jnp.where(apply_snap, L, base)
         log_len = jnp.where(wipe, L, log_len)
         commit = jnp.where(apply_snap, jnp.maximum(commit, L), commit)
+        if rcf:
+            # Snapshot config context installs with the snapshot (raft.py).
+            Lmold = jnp.sum(
+                jnp.where(
+                    sel[:, :, None, :], mb.req_base_mold[:, None], jnp.uint32(0)
+                ),
+                axis=0,
+            )  # [N, W, B]
+            bmold = jnp.where(apply_snap[:, None, :], Lmold, bmold)
+            bpend = jnp.where(apply_snap, pick_h(mb.req_base_pend), bpend)
+            bepoch = jnp.where(apply_snap, pick_h(mb.req_base_epoch), bepoch)
     else:
         apply_snap = snap
 
@@ -375,9 +431,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     out_a_hint = log_len.astype(idt)  # post-append, pre-injection (phase 6 rebinds)
 
     # ---- phase 3.5: PreVote requests (thesis 9.6; raft.py) -----------------------
-    if cfg.pre_vote or rdl:
-        # heard_clock serves the pre-vote quiet rule AND the lease vote
-        # denial (phase 2) -- either gate keeps the leg live (raft.py).
+    if cfg.pre_vote or rdl or rcf:
+        # heard_clock serves the pre-vote quiet rule, the lease vote denial,
+        # and the log-carried-config removed-server denial (phase 2) -- any
+        # gate keeps the leg live (raft.py).
         clock_pv = s.clock + inp.skew  # phase 7's clock; duplicated, CSE'd
         heard = jnp.where(has_ae, clock_pv, s.heard_clock)  # [N, B]
     else:
@@ -500,23 +557,24 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     #     (the CAP-threshold form would do ~6x the work at N=5, CAP=32 and ~400x at
     #     config1's CAP=2048).
     if rcf:
-        # Configuration-masked quorum match (raft.py phase 5): candidates
-        # range over the members' own match values; the member majority is
-        # traced data, so only the count form applies. Joint: min over both
-        # configurations.
+        # Per-leader configuration-masked quorum match (raft.py phase 5):
+        # candidates range over the members' own match values under EACH
+        # leader's OWN derived member rows; dual (min of both configs)
+        # while that leader's prefix is joint.
         mws = match_with_self
         ge_m = mws[:, None, :, :] >= mws[:, :, None, :]  # [i, j(cand), k, B]
 
         def masked_qmatch(mask_b, maj):
-            cnt = jnp.sum(ge_m & mask_b[None, None, :, :], axis=2)  # [N, N, B]
-            ok = (cnt >= maj[None, None, :]) & mask_b[None, :, :]
+            # mask_b [N(i), N(k), B]: node i's member view; maj [N(i), B].
+            cnt = jnp.sum(ge_m & mask_b[:, None, :, :], axis=2)  # [N, N, B]
+            ok = (cnt >= maj[:, None, :]) & mask_b
             return jnp.max(jnp.where(ok, mws, 0), axis=1).astype(jnp.int32)
 
-        mem_old_b = bitplane.unpack(m_old, n, axis=0)  # [N, B]
-        mem_new_b = bitplane.unpack(m_new, n, axis=0)
+        mem_old_b = bitplane.unpack(m_old, n, axis=1)  # [N, N, B]
+        mem_new_b = bitplane.unpack(m_new, n, axis=1)
         qm_old = masked_qmatch(mem_old_b, maj_old)
         quorum_match = jnp.where(
-            joint[None, :],
+            joint,
             jnp.minimum(qm_old, masked_qmatch(mem_new_b, maj_new)),
             qm_old,
         )
@@ -542,45 +600,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         commit,
     )
 
-    # ---- phase 5.2: reconfiguration admin (raft.py for the full rationale) -------
-    if rcf:
-        exit_j = joint & jnp.any(
-            is_leader & inp.alive & member_b
-            & (commit >= (s.cfg_pend - 1)[None, :]),
-            axis=0,
-        )  # [B]
-        m_old2 = jnp.where(exit_j[None, :], m_new, m_old)
-        cfg_pend = jnp.where(exit_j, 0, s.cfg_pend)
-        cfg_epoch = s.cfg_epoch + exit_j
-        joint2 = cfg_pend > 0
-        memb_mid = bitplane.unpack(m_old2 | m_new, n, axis=0)
-        ld_ok = is_leader & inp.alive & memb_mid
-        ld = jnp.min(jnp.where(ld_ok, ids2, n), axis=0)  # [B]
-        t_r = inp.reconfig_cmd  # [B]
-        tbit = bitplane.one_bit(t_r, n)  # [W, B]
-        toggled = m_new ^ tbit
-        accept = (
-            (t_r != NIL)
-            & ~joint2
-            & (ld < n)
-            & (bitplane.count(tbit, axis=0) > 0)
-            & (bitplane.count(toggled, axis=0) >= 2)
-        )
-        ld_len = jnp.sum(jnp.where(ids2 == ld[None, :], log_len, 0), axis=0)  # [B]
-        if cfg.joint_consensus:
-            m_new2 = jnp.where(accept[None, :], toggled, m_new)
-            m_old3 = m_old2
-            cfg_pend = jnp.where(accept, ld_len + 1, cfg_pend)
-        else:
-            # TEST-ONLY mutant: one-step membership change (raft.py).
-            m_new2 = jnp.where(accept[None, :], toggled, m_new)
-            m_old3 = jnp.where(accept[None, :], toggled, m_old2)
-        cfg_epoch = cfg_epoch + accept
-        member_b2 = bitplane.unpack(m_old3 | m_new2, n, axis=0)
-        demote = ~member_b2 & (role != FOLLOWER)
-        role = jnp.where(demote, FOLLOWER, role)
-        leader_id = jnp.where(demote, NIL, leader_id)
-        is_leader = role == LEADER
+    # ---- phase 5.2: reconfiguration transitions moved INTO the log --------------
+    # (Log-carried membership: no admin transition block. Joint entry/exit
+    # are LOG APPENDS -- phase 6 originates them, phase 3 replicates them --
+    # and each node's configuration re-derives from its own prefix at end of
+    # tick; raft.py for the full rationale.)
     if xfr:
         tgt_oh_x = iota((1, n, 1), 1) == jnp.clip(s.xfer_to, 0, n - 1)[:, None, :]
         age_t = jnp.sum(jnp.where(tgt_oh_x, ack_age, 0), axis=1)  # one-hot gather
@@ -589,16 +613,18 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         t_x = inp.transfer_cmd  # [B]
         ld_ok_x = is_leader & inp.alive
         if rcf:
-            ld_ok_x = ld_ok_x & member_b2
+            ld_ok_x = ld_ok_x & member_b
+            # Target must be a voter of the LEADER's own target config
+            # (per-node derived rows; tick-start like every config read).
             t_voter = jnp.any(
-                (m_new2 & bitplane.one_bit(t_x, n)) != 0, axis=0
-            )  # [B]
+                (m_new & bitplane.one_bit(t_x, n)[None]) != 0, axis=1
+            )  # [N, B]
         else:
-            t_voter = jnp.ones_like(t_x, bool)
+            t_voter = jnp.bool_(True)
         ldx = jnp.min(jnp.where(ld_ok_x, ids2, n), axis=0)  # [B]
         can_x = (
             (t_x != NIL)[None, :]
-            & t_voter[None, :]
+            & t_voter
             & (ids2 == ldx[None, :])
             & ld_ok_x
             & (t_x[None, :] != ids2)
@@ -627,6 +653,9 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             )
             fresh_p = bitplane.pack(ack_age <= lease_w, axis=1)  # [N, W, B]
             lease_ok = packed_quorum(fresh_p | eye_p3)
+            if xfr:
+                # Transfer handoff covers the read path (raft.py phase 5).
+                lease_ok = lease_ok & ~xfer_pend
             serve = serve | (keep_r & inp.alive & lease_ok)
         lat_r = jnp.maximum(s.now[None, :] + 1 - s.read_tick, 1)  # [N, B]
         reads_served = jnp.sum(serve, axis=0).astype(jnp.int32)
@@ -718,6 +747,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         target = jnp.minimum(commit, log_len - (cap - cfg.compact_margin))
         base2 = jnp.maximum(base, target)
         bterm = log_ops.term_at_rb(log_term_arr, base, bterm, base2)  # = bterm if unchanged
+        if rcf:
+            # Fold the compacted span's config entries into the snapshot
+            # context (cfglog.fold_span; anchored at the PRE-advance base,
+            # same aliasing rule as the checksum pass -- raft.py phase 5.5).
+            bmold, bpend, bepoch = cfglog.fold_span(
+                cfg, log_cfg_arr, base, base2, bmold, bpend, bepoch,
+                batched=True,
+            )
         base = base2
 
     # ---- committed-prefix checksum, compaction form (raft.py: anchored at
@@ -751,6 +788,41 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         noop = jnp.zeros_like(is_leader)
         room = log_len - base < cap
         noop_blocked = jnp.zeros_like(s.now)
+    # ---- config-entry origination (log-carried membership; raft.py phase 6
+    # for the full rationale: joint entry on the admin toggle, final entry
+    # once the governing joint entry commits on the leader, both judged on
+    # the leader's OWN tick-start derived configuration, sharing the
+    # one-append-per-node slot at priority no-op > config > client) ---------------
+    if rcf:
+        t_r = inp.reconfig_cmd  # [B]
+        tbit = bitplane.one_bit(t_r, n)  # [W, B]; all-zero column for NIL
+        toggled = m_new ^ tbit[None]  # [N, W, B]: each node's view of the result
+        ld_ok = is_leader & inp.alive & member_b & room & ~noop  # [N, B]
+        ldj = jnp.min(jnp.where(ld_ok & ~joint, ids2, n), axis=0)  # [B]
+        accept_j = (
+            (t_r != NIL)[None, :]
+            & (ids2 == ldj[None, :])
+            & ld_ok
+            & ~joint
+            & (bitplane.count(tbit, axis=0) > 0)[None, :]
+            & (bitplane.count(toggled, axis=1) >= 2)
+        )
+        if cfg.joint_consensus:
+            # Pending toggle of this node's open joint phase: the one bit
+            # its member_old and member_new rows differ on.
+            pvbits = bitplane.unpack(m_old ^ m_new, n, axis=1)  # [N, N, B]
+            pend_v = jnp.min(
+                jnp.where(pvbits, iota((1, n, 1), 1), n), axis=1
+            )  # [N, B]
+            accept_f = ld_ok & joint & (commit >= s.cfg_pend)
+            cfg_code = jnp.where(
+                accept_j, t_r[None, :] + 1, jnp.where(accept_f, -(pend_v + 1), 0)
+            ).astype(jnp.int32)
+            cfg_write = accept_j | accept_f
+        else:
+            # TEST-ONLY mutant (single-server change; raft.py phase 6).
+            cfg_code = jnp.where(accept_j, t_r[None, :] + 1, 0).astype(jnp.int32)
+            cfg_write = accept_j
     if cfg.client_redirect:
         # K-deep in-flight pipeline: first free slot takes a fresh offer, at
         # most one slot accepted per node per tick, lowest slot first
@@ -770,6 +842,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         tgt_oh = active[:, None, :] & (tgt[:, None, :] == iota((1, n, 1), 1))  # [K, N, B]
         low_k = jnp.min(jnp.where(tgt_oh, kk3, kdim), axis=0)  # [N, B]
         node_ok = is_leader & inp.alive & room & ~noop  # [N, B]
+        if rcf:
+            node_ok = node_ok & ~cfg_write  # the slot holds a config entry
         if xfr:
             node_ok = node_ok & ~xfer_pend  # transfer lease handoff (raft.py)
         client_ok = (low_k < kdim) & node_ok  # [N, B] nodes accepting a slot
@@ -790,6 +864,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         client_tick = jnp.where(pend_on, ptick, 0) if track else s.client_tick
     else:
         client_ok = (inp.client_cmd[None, :] != NIL) & is_leader & inp.alive & room & ~noop
+        if rcf:
+            client_ok = client_ok & ~cfg_write  # the slot holds a config entry
         if xfr:
             client_ok = client_ok & ~xfer_pend  # transfer lease handoff
         wval_cl = jnp.broadcast_to(inp.client_cmd[None, :], (n, b))
@@ -801,8 +877,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         client_pend = s.client_pend
         client_dst = s.client_dst
         client_tick = s.client_tick
-    do_write = noop | client_ok
+    do_write = (noop | cfg_write | client_ok) if rcf else (noop | client_ok)
     wval = jnp.where(noop, NOOP, wval_cl)  # [N, B]
+    if rcf:
+        # Config entries carry value 0 (the command rides the log_cfg plane).
+        wval = jnp.where(cfg_write, 0, wval)
     # cap matches no slot -> masked-off writes dropped.
     inj_pos = jnp.where(do_write, log_len % cap if comp else log_len, cap)  # [N, B]
     inj_oh = iota((1, cap, 1), 1) == inj_pos[:, None, :]  # [N, CAP, B]
@@ -811,7 +890,15 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     if track:
         # No-op entries carry stamp 0 (protocol filler, never a client offer).
         wtick = jnp.where(noop, 0, wtick_cl)  # [N, B]
+        if rcf:
+            wtick = jnp.where(cfg_write, 0, wtick)  # config entries too
         log_tick_arr = jnp.where(inj_oh, wtick[:, None, :], log_tick_arr)
+    if rcf:
+        # EVERY append writes the config plane (0 for non-config entries):
+        # a slot reused after truncation must never leak its old command.
+        log_cfg_arr = jnp.where(
+            inj_oh, jnp.where(cfg_write, cfg_code, 0)[:, None, :], log_cfg_arr
+        )
     log_len = log_len + do_write
 
     # ---- phase 7: timers ---------------------------------------------------------
@@ -831,7 +918,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         # (raft.py phase 7); real elections start at promotions (phase 4.5).
         start_prevote = expired & ~is_leader
         if rcf:
-            start_prevote = start_prevote & member_b2  # non-voters never campaign
+            # Non-voters never campaign, judged on the node's OWN derived
+            # config (raft.py phase 7: the disruption surface when a log
+            # misses its removal entry).
+            start_prevote = start_prevote & member_b
         if xfr:
             start_prevote = start_prevote & ~xfer_elect  # thesis-3.10 bypass
         role = jnp.where(start_prevote, PRECANDIDATE, role)
@@ -855,9 +945,10 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         start_prevote = jnp.zeros_like(expired)
         start_election = expired & ~is_leader
         if rcf:
-            start_election = start_election & member_b2  # non-voters never campaign
+            start_election = start_election & member_b  # non-voters never campaign
         if xfr:
-            start_election = start_election | (xfer_elect & ~is_leader)
+            xe = xfer_elect & ~is_leader
+            start_election = start_election | xe
         term = term + start_election
         role = jnp.where(start_election, CANDIDATE, role)
         voted_for = jnp.where(start_election, ids2, voted_for)
@@ -904,6 +995,12 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         out_xfer_tgt = jnp.where(fire, xfer_to, NIL).astype(jnp.int8)
     else:
         out_xfer_tgt = mb.xfer_tgt  # NIL, loop-invariant carry component
+    if xfr and (rcf or rdl):
+        # The disruptive-RequestVote override (thesis 3.10/4.2.3; raft.py
+        # phase 8): written only when a denial gate can read it.
+        out_req_disrupt = jnp.where(xe, 1, 0).astype(jnp.int8)
+    else:
+        out_req_disrupt = mb.req_disrupt  # zeros, loop-invariant component
     prev_out = jnp.clip(next_index - 1, 0, len_i[:, None, :])  # [src, dst, B]
     # Shared window start: minimum prev over RESPONSIVE peers, falling back to all
     # peers when none are (see raft.py phase 8 for the liveness argument).
@@ -953,6 +1050,11 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         out_ent_tick = jnp.where(ship_used, wtk, 0)
     else:
         out_ent_tick = mb.ent_tick  # zeros, loop-invariant carry component
+    if rcf:
+        wcf = (log_ops.window_rb if comp else log_ops.window_b)(log_cfg_arr, ws, e)
+        out_ent_cfg = jnp.where(ship_used, wcf, 0)
+    else:
+        out_ent_cfg = mb.ent_cfg  # zeros, loop-invariant carry component
 
     # Responses [receiver, responder]: the edge plane carries only the response
     # TYPE; payloads (grant target, ack target, match, hint, term) are per
@@ -995,6 +1097,20 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
             jnp.where(send_append, bchk, jnp.uint32(0)) if comp else mb.req_base_chk
         ),
         xfer_tgt=out_xfer_tgt,
+        req_disrupt=out_req_disrupt,
+        ent_cfg=out_ent_cfg,
+        req_base_mold=(
+            jnp.where(send_append[:, None, :], bmold, jnp.uint32(0))
+            if (comp and rcf) else mb.req_base_mold
+        ),
+        req_base_pend=(
+            jnp.where(send_append, bpend, 0) if (comp and rcf)
+            else mb.req_base_pend
+        ),
+        req_base_epoch=(
+            jnp.where(send_append, bepoch, 0) if (comp and rcf)
+            else mb.req_base_epoch
+        ),
         req_off=out_req_off,
         resp_kind=out_resp_kind,
         pv_grant=out_pv_grant,
@@ -1015,6 +1131,28 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         else:
             chk_new = s.commit_chk
             chk_ok = jnp.ones_like(s.commit_index, dtype=bool)
+
+    # ---- end-of-tick config derivation (log-carried membership; raft.py) ---------
+    if rcf:
+        d_mold, d_mnew, d_pend, d_epoch, d_hi = cfglog.derive(
+            cfg, log_cfg_arr, log_len, commit, base, bmold, bpend, bepoch,
+            batched=True,
+        )
+        if not cfg.truncation_rollback:
+            # TEST-ONLY mutant (ignore-truncation-rollback; raft.py).
+            rolled = d_epoch < s.cfg_epoch
+            d_mold = jnp.where(rolled[:, None, :], s.member_old, d_mold)
+            d_mnew = jnp.where(rolled[:, None, :], s.member_new, d_mnew)
+            d_pend = jnp.where(rolled, s.cfg_pend, d_pend)
+            d_epoch = jnp.where(rolled, s.cfg_epoch, d_epoch)
+        # Removed-server stepdown + candidacy kill (raft.py end-of-tick).
+        self_in = jnp.any(((d_mold | d_mnew) & eye_p3) != 0, axis=1)  # [N, B]
+        is_cand = (role == CANDIDATE) | (role == PRECANDIDATE)
+        demote = ~self_in & (
+            ((role == LEADER) & (commit >= d_hi)) | is_cand
+        )
+        role = jnp.where(demote, FOLLOWER, role)
+        leader_id = jnp.where(demote, NIL, leader_id)
 
     new_state = ClusterState(
         role=role,
@@ -1037,10 +1175,14 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
         clock=clock,
         deadline=deadline,
         heard_clock=heard,
-        member_old=m_old3 if rcf else s.member_old,
-        member_new=m_new2 if rcf else s.member_new,
-        cfg_epoch=cfg_epoch if rcf else s.cfg_epoch,
-        cfg_pend=cfg_pend if rcf else s.cfg_pend,
+        member_old=d_mold if rcf else s.member_old,
+        member_new=d_mnew if rcf else s.member_new,
+        cfg_epoch=d_epoch if rcf else s.cfg_epoch,
+        cfg_pend=d_pend if rcf else s.cfg_pend,
+        log_cfg=log_cfg_arr,
+        base_mold=bmold if (rcf and comp) else s.base_mold,
+        base_pend=bpend if (rcf and comp) else s.base_pend,
+        base_epoch=bepoch if (rcf and comp) else s.base_epoch,
         xfer_to=xfer_to if xfr else s.xfer_to,
         read_idx=read_idx if rdx else s.read_idx,
         read_tick=read_tick if rdx else s.read_tick,

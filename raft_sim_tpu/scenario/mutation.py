@@ -32,16 +32,45 @@ class WeakQuorumConfig(RaftConfig):
         return self.n_nodes // 2
 
 
-class JointBypassConfig(RaftConfig):
-    """One-step membership change: toggles apply to BOTH configurations
-    instantly, no joint phase (cfg.joint_consensus False). Consecutive
-    changes under replication lag then produce commit quorums and election
-    quorums that do not intersect, so a leader missing committed entries gets
-    elected and replicates its short log over them -- the thesis-4.3
-    motivating bug. Requires cfg.reconfig (reconfig_interval > 0)."""
+class SingleServerChangeConfig(RaftConfig):
+    """Single-server membership change (cfg.joint_consensus False): every
+    config change is ONE log entry that switches the configuration wholly at
+    append -- no joint phase, no completing entry. The known-unsafe
+    interleaving (thesis 4.1 / 4.3's motivating bug): two leaders'
+    uncommitted single-entry changes yield majorities that need not
+    intersect, so a leader missing committed entries gets elected and
+    replicates its short log over them. Requires cfg.reconfig
+    (reconfig_interval > 0)."""
 
     @property
     def joint_consensus(self) -> bool:  # type: ignore[override]
+        return False
+
+
+class ActOnCommitConfig(RaftConfig):
+    """Configs applied at COMMIT instead of append (cfg.act_on_append
+    False): each node derives its configuration from the committed prefix --
+    the dissertation-ch.-4 anti-rule. Nodes then disagree about when a
+    change takes effect (a config entry's commit is itself judged under some
+    config), and the old configuration keeps electing leaders the new one
+    cannot see: disjoint quorums, same-term double leadership. Requires
+    cfg.reconfig (reconfig_interval > 0)."""
+
+    @property
+    def act_on_append(self) -> bool:  # type: ignore[override]
+        return False
+
+
+class IgnoreTruncationRollbackConfig(RaftConfig):
+    """Truncation rollback skipped (cfg.truncation_rollback False): a node
+    whose truncated log LOST config entries keeps acting on the stale
+    derived configuration -- quorums drawn from member sets no log chain
+    ever contained (a briefly-held uncommitted change survives its own
+    truncation as a phantom electorate). Requires cfg.reconfig
+    (reconfig_interval > 0)."""
+
+    @property
+    def truncation_rollback(self) -> bool:  # type: ignore[override]
         return False
 
 
@@ -90,7 +119,13 @@ class LeaseSkewConfig(RaftConfig):
 
 MUTANTS = {
     "weak-quorum": WeakQuorumConfig,
-    "joint-bypass": JointBypassConfig,
+    "single-server-change": SingleServerChangeConfig,
+    # Back-compat alias (pre-ISSUE-13 name for the joint_consensus=False
+    # weakening; under log-carried configs its precise shape is the
+    # single-server change).
+    "joint-bypass": SingleServerChangeConfig,
+    "act-on-commit": ActOnCommitConfig,
+    "ignore-truncation-rollback": IgnoreTruncationRollbackConfig,
     "stale-read": StaleReadConfig,
     "blind-transfer": BlindTransferConfig,
     "lease-skew": LeaseSkewConfig,

@@ -48,7 +48,8 @@ def split_even(total: int, n: int) -> list[int]:
     """Balanced contiguous partition sizes: `total` clusters over `n` tenants,
     remainders to the earliest. THE partition policy -- the serve CLI and the
     bench serve row both build their tenant lists from it, so a future policy
-    change (weighted tenants, the per-tenant QoS follow-up) is one edit."""
+    change (e.g. weighted CLUSTER shares) is one edit. Tick-share QoS is the
+    other axis and already exists: Tenant.weight gates the offer schedule."""
     if not 1 <= n <= total:
         raise ValueError(f"cannot split {total} clusters over {n} tenants")
     return [total // n + (i < total % n) for i in range(n)]
@@ -65,15 +66,28 @@ class Tenant:
     losses."""
 
     def __init__(self, name: str, clusters: int, source=None, reads: int = 0,
-                 read_every: int = 2, broadcast: bool = False):
+                 read_every: int = 2, broadcast: bool = False,
+                 weight: int = 1):
         if clusters < 1:
             raise ValueError(f"tenant {name!r} needs >= 1 cluster")
         if reads < 0:
             raise ValueError(f"tenant {name!r}: reads must be >= 0")
         if read_every < 1:
             raise ValueError(f"tenant {name!r}: read_every must be >= 1")
+        if not isinstance(weight, int) or weight < 1:
+            raise ValueError(
+                f"tenant {name!r}: weight must be an integer >= 1 (integer "
+                "Bresenham credit -- floats would make the offer schedule "
+                "platform-dependent)"
+            )
         self.name = name
         self.clusters = clusters
+        # QoS weight (ROADMAP item 2's named follow-up): the share of OFFER
+        # TICKS this tenant gets relative to the heaviest tenant. The
+        # scheduler is host-side only -- it changes which slots of the
+        # packed [chunk, B] planes carry NIL, never a shape -- so the jit
+        # cache stays flat across any weighting (tests pin it).
+        self.weight = weight
         if source is not None and not isinstance(source, CommandSource):
             source = CommandSource(source)
         self.source = source
@@ -87,6 +101,14 @@ class Tenant:
         self.broadcast = broadcast
         # Assigned by TenantRouter:
         self.lo = self.hi = 0
+        # Read-cadence position IN THE TENANT'S ACTIVE-TICK SEQUENCE (the
+        # router advances it by the weighted schedule's row count each
+        # chunk). Counting active ticks -- not raw global phase -- keeps the
+        # cadence and the weight schedule composable: a global-phase anchor
+        # can land on a residue the Bresenham schedule never selects
+        # (weight 1 of w_max 2 activates odd ticks only; a read_every=2
+        # phase gate wants even ones) and starve a tenant's reads forever.
+        self._read_seq = 0
         # Ledgers:
         self.reads_offered = 0
         self.reads_served = 0  # credited from collected window records
@@ -148,6 +170,14 @@ class TenantRouter:
         self._dir = None
         self._tenant_windows: dict[str, int] = {}
         self._read_phase = 0  # global tick phase of the read cadence
+        # Weighted offer scheduler (per-tenant QoS): tenant t is offered on
+        # the tick slots where its Bresenham credit line crosses an integer
+        # -- floor((k+1) * w_t / w_max) > floor(k * w_t / w_max) at global
+        # tick k -- so over any window its offer ticks are w_t / w_max of
+        # the heaviest tenant's, deterministically and without drift. All
+        # weights equal (the default) makes every slot active: the
+        # pre-weights schedule, bit-for-bit.
+        self._w_max = max(t.weight for t in tenants)
 
     # ------------------------------------------------------------- export IO
 
@@ -179,6 +209,17 @@ class TenantRouter:
 
     # ------------------------------------------------------------ plane side
 
+    def _active_rows(self, t: Tenant, chunk: int) -> list[int]:
+        """The weighted offer schedule: which of this chunk's tick slots
+        tenant t may offer in (writes AND read re-offers). Bresenham credit
+        against the heaviest weight, anchored on the global tick phase."""
+        w, wm = t.weight, self._w_max
+        k0 = self._read_phase
+        return [
+            k for k in range(chunk)
+            if ((k0 + k + 1) * w) // wm > ((k0 + k) * w) // wm
+        ]
+
     def pack(self, chunk: int) -> tuple[np.ndarray, np.ndarray | None]:
         """The next chunk's per-cluster planes from every tenant's queues."""
         cmds = np.full((chunk, self.batch), NIL, np.int32)
@@ -188,30 +229,38 @@ class TenantRouter:
             else None
         )
         for t in self.tenants:
-            if t.source is not None and not t.source.exhausted:
+            rows = self._active_rows(t, chunk)
+            if t.source is not None and not t.source.exhausted and rows:
                 if t.broadcast:
-                    vals = t.source.next_values(chunk)
-                    cmds[:, t.lo:t.hi] = pack_plane(vals, chunk, 1)
+                    vals = t.source.next_values(len(rows))
+                    cmds[rows, t.lo:t.hi] = pack_plane(vals, len(rows), 1)
                 else:
-                    vals = t.source.next_values(chunk * t.clusters)
-                    cmds[:, t.lo:t.hi] = pack_plane(vals, chunk, t.clusters)
+                    vals = t.source.next_values(len(rows) * t.clusters)
+                    cmds[rows, t.lo:t.hi] = pack_plane(
+                        vals, len(rows), t.clusters
+                    )
             if reads is not None and t.reads_served < t.reads:
                 # Offer up to the OUTSTANDING demand (demand minus serves
                 # already credited -- crediting lags a chunk, so the
                 # over-offer is bounded by one chunk's serves; reads are
                 # fungible and extra serves are harmless), at most one read
-                # per cluster every read_every ticks: dropped offers
-                # re-offer next chunk.
+                # per cluster every read_every ACTIVE ticks of the tenant's
+                # weighted schedule (t._read_seq -- see its init comment for
+                # why the cadence must not anchor on global phase): dropped
+                # offers re-offer next chunk. All weights equal, rows is
+                # every tick and _read_seq IS the global phase -- the
+                # pre-weights schedule bit-for-bit.
                 want = t.reads - t.reads_served
-                for k in range(chunk):
+                for j, k in enumerate(rows):
                     if want <= 0:
                         break
-                    if (self._read_phase + k) % t.read_every:
+                    if (t._read_seq + j) % t.read_every:
                         continue
                     lanes = min(want, t.clusters)
                     reads[k, t.lo:t.lo + lanes] = 1
                     t.reads_offered += lanes
                     want -= lanes
+            t._read_seq = (t._read_seq + len(rows)) % (2 ** 30)
         self._read_phase = (self._read_phase + chunk) % (2 ** 30)
         return cmds, reads
 

@@ -55,13 +55,6 @@ import json
 from raft_sim_tpu.trace import events as tev
 from raft_sim_tpu.trace.history import Event, History
 
-# Same-term leader elections closer than this many configuration-epoch bumps
-# always share a voter (see _check_cluster): 4 = two completed joint cycles,
-# the minimum separation at which two single-config majorities can be
-# disjoint. Conservative for mutant kernels that bump epochs without joint
-# phases -- their signature also fires commit/completeness properties.
-EPOCH_EXEMPT_DISTANCE = 4
-
 PROPERTIES = (
     "election_safety",
     "leader_append_only",
@@ -112,25 +105,30 @@ class CheckReport:
 def _check_cluster(c: int, evs: list[Event], fail) -> None:
     """Replay one cluster's timeline; report violations via fail(prop,
     witness_events, note)."""
-    # Election safety is scoped per CONFIGURATION EPOCH DISTANCE (EV_EPOCH
-    # events, raft_sim_tpu/reconfig): under the admin-driven membership
-    # model two leaders may legally hold one term number across DISTANT
-    # epochs (their electorates need not overlap once the configuration
-    # moved far enough), but any two single-configuration majorities less
-    # than two full joint cycles apart provably intersect -- one toggle
-    # changes the member set by a single node, and maj(M) + maj(M ^ {v}) >
-    # |M union {v}| for both add and remove, while a joint epoch's DUAL
-    # electorate intersects both its neighbors by construction. Two full
-    # cycles = 4 epoch bumps (enter, exit, enter, exit), so same-term
-    # leaders with epoch distance < EPOCH_EXEMPT_DISTANCE always imply a
-    # double-voted node: a genuine violation. Epoch transitions replay at
-    # end-of-tick (cluster-scope kinds order last), matching the kernel's
-    # phase order (elections precede the phase-5.2 transition). Without the
-    # reconfiguration plane no EV_EPOCH ever fires and the scope is the
-    # whole run -- exactly the old behavior.
-    epoch = 0
-    leaders_by_term: dict[int, list[tuple[int, Event]]] = {}  # term -> [(epoch, ev)]
+    # Election safety is UNCONDITIONAL per term under log-carried
+    # configuration (models/cfglog.py; thesis 4.3): every vote is cast under
+    # the voter's own log-derived configuration, every configuration is a
+    # chain of log entries from the boot config, and joint consensus makes
+    # adjacent configurations' majorities intersect -- so two same-term
+    # leaders ALWAYS imply a double-voted node or a broken config chain
+    # (exactly what the act-on-commit / single-server-change mutants break).
+    # The admin-era EPOCH_EXEMPT_DISTANCE carve-out is GONE: it existed
+    # because lockstep admin switches were not log entries, so distant
+    # electorates could legally be disjoint; per-node log-carried configs
+    # cannot. A second, per-voter check keys on (voter, term): granting two
+    # DIFFERENT candidates in one term is named directly -- under log-carried
+    # configs no config state can excuse it, so the config is deliberately
+    # NOT part of the key -- while an idempotent re-grant (same candidate,
+    # e.g. after a restart) stays legal. Each node's cfg_epoch is replayed
+    # from the EV_CFG_APPLY/EV_CFG_ROLLBACK stream and recorded with every
+    # vote for ATTRIBUTION only: the failure note names the config era each
+    # grant was cast under (what makes act-on-commit witnesses readable).
+    leaders_by_term: dict[int, list[Event]] = {}  # term -> [ev]
     leader_set: dict[int, Event] = {}  # node -> its EV_LEADER event
+    node_term: dict[int, int] = {}  # node -> current term (role/term events)
+    node_cfg_epoch: dict[int, int] = {}  # node -> derived config epoch
+    votes_cast: dict[tuple[int, int], tuple[int, int, Event]] = {}
+    # (voter, term) -> (candidate, cfg_epoch at vote time, ev)
     frontier = 0
     frontier_ev: Event | None = None
     last_commit: dict[int, tuple[int, Event]] = {}
@@ -145,8 +143,27 @@ def _check_cluster(c: int, evs: list[Event], fail) -> None:
         k = e.kind
         if k in (tev.EV_FOLLOWER, tev.EV_PRECANDIDATE, tev.EV_CANDIDATE):
             leader_set.pop(e.node, None)
-        elif k == tev.EV_EPOCH:
-            epoch = e.detail
+            node_term[e.node] = e.detail  # role kinds carry the new term
+        elif k == tev.EV_TERM:
+            node_term[e.node] = e.detail
+        elif k in (tev.EV_CFG_APPLY, tev.EV_CFG_ROLLBACK):
+            node_cfg_epoch[e.node] = e.detail  # detail = the new cfg_epoch
+        elif k == tev.EV_VOTE:
+            # Double-vote detection, keyed on the voter's (term, config) at
+            # vote time: granting two DIFFERENT candidates in one term is a
+            # genuine election-safety break no configuration can excuse;
+            # re-granting the SAME candidate (restart re-grant) is legal.
+            t = node_term.get(e.node, 0)
+            ce = node_cfg_epoch.get(e.node, 0)
+            prev_v = votes_cast.get((e.node, t))
+            if prev_v is not None and prev_v[0] != e.detail:
+                fail(
+                    "election_safety", [prev_v[2], e],
+                    f"cluster {c}: node {e.node} voted for both node "
+                    f"{prev_v[0]} (config epoch {prev_v[1]}) and node "
+                    f"{e.detail} (config epoch {ce}) in term {t}",
+                )
+            votes_cast[(e.node, t)] = (e.detail, ce, e)
         elif k == tev.EV_READ_ISSUE:
             pending_reads[e.node] = (e.detail, frontier, e)
         elif k == tev.EV_READ_SERVE:
@@ -161,24 +178,20 @@ def _check_cluster(c: int, evs: list[Event], fail) -> None:
                 )
         elif k == tev.EV_LEADER:
             term = e.detail
-            prior = next(
-                (
-                    (pe, pev)
-                    for pe, pev in leaders_by_term.get(term, [])
-                    if abs(epoch - pe) < EPOCH_EXEMPT_DISTANCE
-                ),
-                None,
-            )
+            node_term[e.node] = term
+            prior = next(iter(leaders_by_term.get(term, [])), None)
             if prior is not None:
                 fail(
-                    "election_safety", [prior[1], e],
-                    f"cluster {c}: two leaders elected for term {term} in "
-                    f"config epochs {prior[0]}/{epoch} -- electorates less "
-                    f"than {EPOCH_EXEMPT_DISTANCE} epoch bumps apart always "
-                    f"intersect (node {prior[1].node} at tick "
-                    f"{prior[1].tick}, node {e.node} at tick {e.tick})",
+                    "election_safety", [prior, e],
+                    f"cluster {c}: two leaders elected for term {term} "
+                    f"(node {prior.node} at tick {prior.tick}, node "
+                    f"{e.node} at tick {e.tick}) -- under log-carried "
+                    "configuration every electorate chains from the boot "
+                    "config through joint phases, so same-term majorities "
+                    "always intersect: a double-voted node or a broken "
+                    "config chain (act-on-commit / single-server-change)",
                 )
-            leaders_by_term.setdefault(term, []).append((epoch, e))
+            leaders_by_term.setdefault(term, []).append(e)
             leader_set[e.node] = e
         elif k == tev.EV_TRUNCATE:
             led = leader_set.get(e.node)

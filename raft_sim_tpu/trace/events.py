@@ -74,18 +74,30 @@ EV_DROP = 12
 # Reconfiguration-plane kinds (raft_sim_tpu/reconfig). Read kinds sit ABOVE
 # the commit kind on purpose: a read served this tick is checked against
 # commits that landed this tick (the kernel serves against the
-# post-advancement commit), so the checker must replay commit before serve;
-# EV_EPOCH rides cluster scope at end-of-tick, matching the kernel's phase
-# order (elections precede the phase-5.2 configuration transition). detail
-# semantics: xfer = target node; read issue/serve = the captured read index;
-# epoch = the new configuration epoch.
+# post-advancement commit), so the checker must replay commit before serve.
+# detail semantics: xfer = target node; read issue/serve = the captured read
+# index.
 EV_XFER = 13
 EV_READ_ISSUE = 14
 EV_READ_SERVE = 15
-EV_VIOLATION = 16
-EV_PARTITION = 17
-EV_EPOCH = 18
-N_KINDS = 19
+# Log-carried configuration kinds (models/cfglog.py), PER NODE -- the
+# admin-era cluster-scope EV_EPOCH is gone with the admin model: configuration
+# is per-node derived state now, so its events attribute to the node whose
+# log changed. All three replay after the role/commit/truncate kinds,
+# matching the kernel's end-of-tick derivation. detail semantics:
+#   cfg_append    config-entry slots written to this node's log this tick
+#                 (origination or replication)
+#   cfg_apply     the node's NEW cfg_epoch after entries entered its derived
+#                 config (apply-on-append: same tick as the append on the
+#                 real kernel; commit-lagged on the act-on-commit mutant)
+#   cfg_rollback  the node's NEW cfg_epoch after a truncation REMOVED config
+#                 entries from its prefix (the dissertation's rollback)
+EV_CFG_APPEND = 16
+EV_CFG_APPLY = 17
+EV_CFG_ROLLBACK = 18
+EV_VIOLATION = 19
+EV_PARTITION = 20
+N_KINDS = 21
 
 KINDS = {
     "follower": EV_FOLLOWER,
@@ -105,7 +117,9 @@ KINDS = {
     "xfer": EV_XFER,
     "read_issue": EV_READ_ISSUE,
     "read_serve": EV_READ_SERVE,
-    "epoch": EV_EPOCH,
+    "cfg_append": EV_CFG_APPEND,
+    "cfg_apply": EV_CFG_APPLY,
+    "cfg_rollback": EV_CFG_ROLLBACK,
 }
 KIND_NAMES = {v: k for k, v in KINDS.items()}
 
@@ -116,8 +130,9 @@ PER_NODE_KINDS = (
     EV_FOLLOWER, EV_PRECANDIDATE, EV_CANDIDATE, EV_LEADER, EV_TERM, EV_VOTE,
     EV_COMMIT, EV_APPEND, EV_TRUNCATE, EV_CRASH, EV_RESTART, EV_DROP,
     EV_XFER, EV_READ_ISSUE, EV_READ_SERVE,
+    EV_CFG_APPEND, EV_CFG_APPLY, EV_CFG_ROLLBACK,
 )
-CLUSTER_KINDS = (EV_VIOLATION, EV_PARTITION, EV_EPOCH)
+CLUSTER_KINDS = (EV_VIOLATION, EV_PARTITION)
 
 # Violation bitmask bits (EV_VIOLATION detail).
 VIOL_ELECTION = 1
@@ -224,10 +239,36 @@ def extract(
         & (new.term == old.term)
         & ~inp.restarted
     )
+    # Log-carried configuration kinds, per node: append = the log_cfg plane
+    # gained entries (delta over the slot planes, statically gated --
+    # disabled configs carry the plane untouched and the compare would be
+    # [N, CAP]-sized dead work); apply/rollback = the derived cfg_epoch
+    # moved (the end-of-tick derivation counts config entries in the
+    # prefix, so epoch-up = entries entered the effective config and
+    # epoch-down = a truncation removed them). Known append-event limit: a
+    # slot-value compare cannot see a config entry re-replicated into a slot
+    # still holding the IDENTICAL code from a truncated-away predecessor
+    # (truncation shortens log_len without scrubbing slots) -- the coverage
+    # bitmap undercounts that one append, but the epoch channel still fires
+    # cfg_apply for it, so the checker's config replay is unaffected.
+    if cfg.reconfig:
+        chg = (new.log_cfg != old.log_cfg) & (new.log_cfg != 0)
+        cfg_append = jnp.any(chg, axis=1)
+        cfg_append_d = jnp.sum(chg, axis=1).astype(jnp.int32)
+        cfg_apply = new.cfg_epoch > old.cfg_epoch
+        cfg_rollback = new.cfg_epoch < old.cfg_epoch
+    else:
+        cfg_append = jnp.zeros(new.term.shape, bool)
+        cfg_append_d = z32
+        cfg_apply = jnp.zeros(new.term.shape, bool)
+        cfg_rollback = jnp.zeros(new.term.shape, bool)
     blocks = blocks + (
         (xfer_flag, new.xfer_to),
         (read_issue, new.read_idx - 1),
         (read_serve, old.read_idx - 1),
+        (cfg_append, cfg_append_d),
+        (cfg_apply, new.cfg_epoch),
+        (cfg_rollback, new.cfg_epoch),
     )
     viol_mask = (
         info.viol_election_safety * VIOL_ELECTION
@@ -238,10 +279,6 @@ def extract(
     cluster = (
         (_bc(viol_mask != 0, like), _bc(viol_mask, like)),
         (_bc(cut_now != cut_prev, like), _bc(cut_now, like)),
-        (
-            _bc(new.cfg_epoch != old.cfg_epoch, like),
-            _bc(new.cfg_epoch, like),
-        ),
     )
     flags = jnp.concatenate([f for f, _ in blocks] + [f for f, _ in cluster])
     detail = jnp.concatenate(

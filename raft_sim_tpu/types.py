@@ -209,6 +209,29 @@ class Mailbox(NamedTuple):
     # (REQ_TIMEOUT_NOW). Per sender like every request header -- a leader
     # fires at most one transfer per tick.
     xfer_tgt: jax.Array  # [N(sender)] int8: TimeoutNow target node (NIL = none)
+    # Disruptive-RequestVote flag (thesis 4.2.3's override, paired with
+    # TimeoutNow in 3.10): set on the RequestVote broadcast of a transfer-
+    # triggered election, so voters holding the heard-a-leader denial (live
+    # under cfg.reconfig -- the removed-server disruption defense -- or
+    # cfg.read_lease) still process THIS election: it was sanctioned by the
+    # leader being replaced, so denying it would deadlock every transfer.
+    # Written only when the flag has a reader (cfg.leader_transfer AND a
+    # denial gate); zeros and carried untouched otherwise.
+    req_disrupt: jax.Array  # [N(sender)] int8: 1 = transfer-sanctioned RequestVote
+    # Config-entry plane of the shared window (cfg.reconfig only; zeros and
+    # carried untouched otherwise): entry k's config command replicates NEXT
+    # TO its value, exactly like the offer-stamp plane -- so a follower's
+    # log prefix carries the configuration history its derived membership
+    # reads (models/cfglog.py). 0 = not a config entry; +(v+1) = joint entry
+    # toggling node v; -(v+1) = final entry completing that toggle.
+    ent_cfg: jax.Array  # [N, E] int32: src's shared entry window (config commands)
+    # Snapshot config header (compaction AND reconfig; zeros otherwise): the
+    # sender's configuration context at its compaction base, installed with
+    # the snapshot so the receiver's derived config stays exact when config
+    # entries were compacted away (base_mold/base_pend/base_epoch legs).
+    req_base_mold: jax.Array  # [N, W] uint32: sender's C_old at its base
+    req_base_pend: jax.Array  # [N] int32: sender's pending toggle code at base
+    req_base_epoch: jax.Array  # [N] int32: sender's config-entry count at base
     req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E; -1 = snapshot
     resp_kind: jax.Array  # [N(receiver), N(responder)] int8 (RESP_*): response type per edge
     pv_grant: jax.Array  # [N(receiver), W] uint32: packed pre-vote grant bits (bit = responder)
@@ -295,24 +318,44 @@ class ClusterState(NamedTuple):
     # when cfg.pre_vote; untouched (loop-invariant) otherwise.
     heard_clock: jax.Array  # [N] int32
     # Reconfiguration plane (cfg.reconfig; zeros and carried untouched
-    # otherwise -- raft_sim_tpu/reconfig, thesis chapter 4). Cluster-scoped
-    # ADMIN state, not per-node protocol state: the membership service is the
-    # simulator's external operator, so every node reads the same
-    # configuration instantly (the per-node config-in-log divergence of full
-    # Raft is out of scope; docs/PROTOCOL.md states the model precisely).
-    # member_old is the current voting configuration C_old as a packed
-    # bitplane row (bit j = node j votes); during a joint phase
-    # (cfg_pend > 0) member_new holds the target C_new and every quorum test
-    # -- election, pre-vote promotion, commit advancement, ReadIndex
-    # confirmation -- requires a majority of BOTH rows (dual popcount). The
-    # joint phase exits when a live member leader's commit reaches
-    # cfg_pend - 1 (everything up to the change point replicated under the
-    # dual quorum); cfg_epoch bumps on each phase transition so safety
-    # properties are attributable per configuration era.
-    member_old: jax.Array  # [W] uint32: packed C_old voting-membership bits
-    member_new: jax.Array  # [W] uint32: packed C_new (== C_old outside joint)
-    cfg_epoch: jax.Array  # scalar int32: configuration epoch counter
-    cfg_pend: jax.Array  # scalar int32: joint-exit commit bound + 1 (0 = not joint)
+    # otherwise -- raft_sim_tpu/reconfig, thesis chapter 4). LOG-CARRIED,
+    # PER-NODE protocol state: configuration changes ride the replicated log
+    # as entries (the log_cfg plane below), and each node's effective
+    # configuration is DERIVED FROM ITS OWN LOG PREFIX -- applied the moment
+    # an entry is appended (never waiting for commit, dissertation ch. 4)
+    # and rolled back when a truncation removes it (models/cfglog.py is the
+    # single derivation; docs/PROTOCOL.md states the model). These four
+    # leaves are the derived cache, recomputed at the end of every tick from
+    # the post-append post-compaction log; quorum tests -- election,
+    # pre-vote promotion, commit advancement, ReadIndex/lease confirmation
+    # -- read the TICK-START values, each node masking by ITS OWN rows (dual
+    # popcount of member_old AND member_new while that node's cfg_pend marks
+    # an uncompleted joint entry in its prefix). Log-derived state survives
+    # restart with the log; crash faults never touch it directly.
+    member_old: jax.Array  # [N, W] uint32: node i's C_old from its own log prefix
+    member_new: jax.Array  # [N, W] uint32: node i's C_new (== C_old outside joint)
+    cfg_epoch: jax.Array  # [N] int32: config entries in node i's prefix (+ base_epoch)
+    cfg_pend: jax.Array  # [N] int32: abs index of the governing joint entry (0 = none)
+    # Config-entry log plane (cfg.reconfig; zeros otherwise): slot k's config
+    # command, written beside log_term/log_val at every append (AE
+    # replication via Mailbox.ent_cfg, leader origination at injection) and
+    # ZEROED by non-config appends, so a truncated-then-overwritten slot can
+    # never leak a stale config entry into the derivation. Encoding:
+    # 0 = not a config entry; +(v+1) = joint entry toggling node v's
+    # membership; -(v+1) = final entry completing that toggle. Part of the
+    # Raft persistent log (restart keeps it).
+    log_cfg: jax.Array  # [N, CAP] int32
+    # Snapshot config context (compaction AND reconfig; zeros otherwise):
+    # the configuration facts at log_base, so the derivation stays exact
+    # after committed config entries compact away -- C_old at base, the
+    # pending (unmatched-joint) toggle code at base (0 = none), and the
+    # config-entry count at/below base. Advances with log_base (the
+    # compacted span's entries fold in) and installs from the snapshot
+    # header (Mailbox.req_base_mold/...). Restart-persistent with the
+    # snapshot triple it extends.
+    base_mold: jax.Array  # [N, W] uint32: C_old at log_base
+    base_pend: jax.Array  # [N] int32: pending toggle code at base (0 = none)
+    base_epoch: jax.Array  # [N] int32: config entries at or below base
     # Leadership-transfer plane (cfg.leader_transfer; NIL and carried
     # untouched otherwise): a transferring leader's pending TimeoutNow target
     # (thesis 3.10). Volatile leader state: cleared on role loss, term
@@ -504,6 +547,11 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         req_base_term=i(n),
         req_base_chk=jnp.zeros((n,), jnp.uint32),
         xfer_tgt=jnp.full((n,), NIL, jnp.int8),
+        req_disrupt=jnp.zeros((n,), jnp.int8),
+        ent_cfg=i(n, e),
+        req_base_mold=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
+        req_base_pend=i(n),
+        req_base_epoch=i(n),
         req_off=jnp.zeros((n, n), jnp.int8),
         resp_kind=jnp.zeros((n, n), jnp.int8),
         pv_grant=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
@@ -544,18 +592,29 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         deadline=deadline,
         # "Quiet since before time began": pre-votes are grantable at boot.
         heard_clock=jnp.full((n,), -cfg.election_min_ticks, jnp.int32),
-        # Reconfiguration plane: every node votes at boot (C_old = all) when
-        # the plane is live; all-zero dead weight otherwise.
+        # Reconfiguration plane: every node derives the all-voters boot
+        # config from its (empty) log prefix when the plane is live --
+        # per-node rows, one per node; all-zero dead weight otherwise.
         member_old=(
-            bitplane.full_row(n) if cfg.reconfig
-            else jnp.zeros((bitplane.n_words(n),), jnp.uint32)
+            jnp.broadcast_to(bitplane.full_row(n), (n, bitplane.n_words(n)))
+            if cfg.reconfig
+            else jnp.zeros((n, bitplane.n_words(n)), jnp.uint32)
         ),
         member_new=(
-            bitplane.full_row(n) if cfg.reconfig
-            else jnp.zeros((bitplane.n_words(n),), jnp.uint32)
+            jnp.broadcast_to(bitplane.full_row(n), (n, bitplane.n_words(n)))
+            if cfg.reconfig
+            else jnp.zeros((n, bitplane.n_words(n)), jnp.uint32)
         ),
-        cfg_epoch=jnp.int32(0),
-        cfg_pend=jnp.int32(0),
+        cfg_epoch=jnp.zeros((n,), jnp.int32),
+        cfg_pend=jnp.zeros((n,), jnp.int32),
+        log_cfg=jnp.zeros((n, cap), jnp.int32),
+        base_mold=(
+            jnp.broadcast_to(bitplane.full_row(n), (n, bitplane.n_words(n)))
+            if cfg.reconfig
+            else jnp.zeros((n, bitplane.n_words(n)), jnp.uint32)
+        ),
+        base_pend=jnp.zeros((n,), jnp.int32),
+        base_epoch=jnp.zeros((n,), jnp.int32),
         xfer_to=jnp.full((n,), NIL, jnp.int32),  # NIL = idle, gate on or off
         read_idx=jnp.zeros((n,), jnp.int32),
         read_tick=jnp.zeros((n,), jnp.int32),

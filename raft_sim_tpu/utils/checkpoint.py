@@ -96,7 +96,19 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      loop-invariant unless cfg.read_lease (read_lease_ticks > 0). Mailbox
 #      and RunMetrics are unchanged (the staleness flag folds into the
 #      existing violations counter).
-_FORMAT_VERSION = 23
+# v24: log-carried configuration (ISSUE 13; models/cfglog.py) -- the admin
+#      membership plane became PER-NODE derived state: member_old/member_new
+#      are [N, W] rows (one per node, each derived from that node's own log
+#      prefix), cfg_epoch/cfg_pend are [N] vectors; ClusterState gained the
+#      log_cfg config-entry plane ([N, CAP] int32 commands beside the log)
+#      and the snapshot config context (base_mold/base_pend/base_epoch).
+#      Mailbox gained req_disrupt (the disruptive-RequestVote transfer
+#      override flag), ent_cfg (the shared-window config-command plane), and
+#      the snapshot config header (req_base_mold/req_base_pend/
+#      req_base_epoch). All new leaves are zeros and loop-invariant unless
+#      cfg.reconfig (and the snapshot legs additionally need
+#      cfg.compaction). RunMetrics unchanged.
+_FORMAT_VERSION = 24
 
 # The single exported source of truth for the on-disk format version
 # (re-exported as raft_sim_tpu.CHECKPOINT_FORMAT_VERSION). Everything that
@@ -112,7 +124,7 @@ FORMAT_VERSION = _FORMAT_VERSION
 # refreshing this pin -- the convention the v2..v19 log always relied on,
 # now machine-checked. Refresh with:
 #     python -c "from raft_sim_tpu.analysis import policy; print(policy.schema_fingerprint())"
-_SCHEMA_FINGERPRINT = (23, "0fdaffbacf9a1f5f")
+_SCHEMA_FINGERPRINT = (24, "37bbb4a654ebd158")
 
 
 def _normalize(path: str) -> str:

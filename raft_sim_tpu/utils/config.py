@@ -300,16 +300,15 @@ class RaftConfig:
                 f"{self.election_min_ticks + 2}) must stay below the ack_age "
                 f"saturation ceiling ({self.ack_age_sat})"
             )
-            # No transfer-override flag exists yet (thesis 3.10 pairs
-            # TimeoutNow with a disruptive-RequestVote flag that bypasses the
-            # lease denial); without it a transfer target's election would be
-            # denied by the very lease it is meant to inherit. Named
-            # follow-up in docs/PROTOCOL.md.
-            assert self.transfer_interval == 0, (
-                "read_lease_ticks and transfer_interval are mutually "
-                "exclusive until the lease-override RequestVote flag exists "
-                "(docs/PROTOCOL.md, lease reads follow-ups)"
-            )
+            # Lease reads and TimeoutNow transfers COEXIST since the
+            # disruptive-RequestVote override (thesis 3.10 pairs TimeoutNow
+            # with a flag that bypasses the 4.2.3 denial): a transfer
+            # target's election carries Mailbox.req_disrupt, voters process
+            # it despite their lease obligation, and the transferring leader
+            # stops serving lease reads while the transfer pends (the
+            # handoff covers the read path too -- docs/PROTOCOL.md "Lease
+            # reads" staleness argument). The PR-11 mutual-exclusion
+            # validator is gone.
 
     @property
     def track_offer_ticks(self) -> bool:
@@ -361,9 +360,31 @@ class RaftConfig:
     # re-find the injected bug. Production configs always return True.
     @property
     def joint_consensus(self) -> bool:
-        """False (mutants only): membership toggles apply IMMEDIATELY with no
-        joint phase -- the classic one-step membership change whose old/new
-        quorums need not intersect (thesis 4.3's motivating bug)."""
+        """False (mutants only): a membership change is ONE log entry that
+        switches the configuration wholly at append -- the single-server
+        change (thesis 4.1) with its known-unsafe interleaving: two leaders'
+        uncommitted single-entry changes can yield majorities that do not
+        intersect (the bug the joint phase exists to rule out)."""
+        return True
+
+    @property
+    def act_on_append(self) -> bool:
+        """False (mutants only): each node derives its configuration from
+        the COMMITTED prefix of its log instead of the whole appended prefix
+        -- "act on commit", the dissertation-ch.-4 anti-rule. Nodes then
+        disagree about when a change takes effect (a config entry's commit
+        is itself judged under some config), and the old configuration keeps
+        electing leaders the new one cannot see: disjoint quorums."""
+        return True
+
+    @property
+    def truncation_rollback(self) -> bool:
+        """False (mutants only): a node whose truncated log LOST config
+        entries keeps acting on the stale derived configuration (the
+        rollback the dissertation requires is skipped). A follower that
+        briefly held an uncommitted change then truncated it keeps voting
+        under the phantom configuration -- quorums drawn from member sets no
+        log chain ever contained."""
         return True
 
     @property

@@ -88,9 +88,13 @@ def state_to_dict(state) -> dict:
     d["votes"] = unpack_plane(d["votes"], n)
     d["mailbox"]["pv_grant"] = unpack_plane(d["mailbox"]["pv_grant"], n)
     # Reconfiguration / ReadIndex packed planes: the oracle's view (and the
-    # parity tests' comparison domain) is the dense boolean one.
+    # parity tests' comparison domain) is the dense boolean one. member_old/
+    # member_new/base_mold are PER-NODE rows ([N, W] -> [N, N]): row i is
+    # node i's configuration as derived from its own log prefix.
     d["member_old"] = unpack_plane(d["member_old"], n)
     d["member_new"] = unpack_plane(d["member_new"], n)
+    d["base_mold"] = unpack_plane(d["base_mold"], n)
+    d["mailbox"]["req_base_mold"] = unpack_plane(d["mailbox"]["req_base_mold"], n)
     d["read_acks"] = unpack_plane(d["read_acks"], n)
     return d
 
@@ -147,10 +151,17 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     log_len = s["log_len"].copy()
     deadline = s["deadline"].copy()
     heard_clock = s["heard_clock"].copy()
-    member_old = s["member_old"].copy()  # [N] bool (oracle view: unpacked)
+    # Log-carried configuration (models/cfglog.py): per-node derived state.
+    # Row d of member_old/member_new is node d's own view; the end-of-tick
+    # derivation below recomputes all of it from the post-append log.
+    member_old = s["member_old"].copy()  # [N, N] bool (oracle view: unpacked)
     member_new = s["member_new"].copy()
-    cfg_epoch = int(s["cfg_epoch"])
-    cfg_pend = int(s["cfg_pend"])
+    cfg_epoch = np.asarray(s["cfg_epoch"], np.int32).copy()  # [N]
+    cfg_pend = np.asarray(s["cfg_pend"], np.int32).copy()  # [N]
+    log_cfg = s["log_cfg"].copy()  # [N, CAP] config-entry commands
+    base_mold = s["base_mold"].copy()  # [N, N] bool: C_old at each node's base
+    base_pend = np.asarray(s["base_pend"], np.int32).copy()  # [N]
+    base_epoch = np.asarray(s["base_epoch"], np.int32).copy()  # [N]
     xfer_to = np.asarray(s["xfer_to"], np.int32).copy()
     read_idx = s["read_idx"].copy()
     read_tick = s["read_tick"].copy()
@@ -173,9 +184,10 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             commit[d] = log_base[d]
             commit_chk[d] = base_chk[d]
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
-            if cfg.pre_vote or rdl:
+            if cfg.pre_vote or rdl or rcf:
                 # a restarted node remembers no leader contact (pre-votes
-                # grantable; under the lease gate, real votes too)
+                # grantable; under the lease or log-carried-config denial
+                # gates, real votes too)
                 heard_clock[d] = int(s["clock"][d]) - cfg.election_min_ticks
             if xfr:
                 xfer_to[d] = NIL  # pending transfers die with the process
@@ -186,27 +198,34 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 if rdl:
                     read_fr[d] = 0  # the staleness anchor dies with the slot
 
-    # Reconfiguration plane: the TICK-START configuration governs every
-    # quorum test this tick (models/raft.py); phase 5.2 transitions apply
-    # afterward. The quorum helper closes over SNAPSHOTS -- the 5.2 block
-    # rebinds member_old/member_new in place, and a late-bound closure would
-    # judge the ReadIndex confirmation (which runs after 5.2) under the
-    # post-transition masks while the kernel pins the tick-start ones.
-    joint0 = cfg_pend > 0
+    # Log-carried configuration: the TICK-START derivation governs every
+    # quorum test this tick (models/raft.py); the end-of-tick block
+    # recomputes it from the post-append log. Each node masks by ITS OWN
+    # rows -- dual (both configs) while that node's own prefix holds an
+    # uncompleted joint entry. The helper closes over SNAPSHOTS so later
+    # phases' rebinds cannot leak in.
     if rcf:
-        q_member_old = member_old.copy()  # tick-start masks, never rebound
+        q_member_old = member_old.copy()  # [N, N] tick-start, never rebound
         q_member_new = member_new.copy()
-        maj_old = int(q_member_old.sum()) // 2 + 1
-        maj_new = int(q_member_new.sum()) // 2 + 1
-        member_b = q_member_old | q_member_new
+        joint0 = cfg_pend > 0  # [N]
+        maj_old = q_member_old.sum(axis=1) // 2 + 1  # [N]
+        maj_new = q_member_new.sum(axis=1) // 2 + 1
+        # member_b[d]: is d a voter of ITS OWN config union? A node whose
+        # log carries its removal quiesces; one whose log MISSES it still
+        # campaigns -- the removed-server disruption the 4.2.3 denial below
+        # defends against.
+        member_b = np.array(
+            [(q_member_old[d, d] or q_member_new[d, d]) for d in range(n)], bool
+        )
 
-    def packed_quorum_row(grants_row: np.ndarray) -> bool:
-        """grants_row: [N] bool of banked grants -> config-masked quorum."""
+    def packed_quorum_row(d: int, grants_row: np.ndarray) -> bool:
+        """grants_row: [N] bool of node d's banked grants -> quorum under
+        node d's OWN configuration(s)."""
         if not rcf:
             return int(grants_row.sum()) >= cfg.quorum
-        ok = int((grants_row & q_member_old).sum()) >= maj_old
-        if joint0:
-            ok = ok and int((grants_row & q_member_new).sum()) >= maj_new
+        ok = int((grants_row & q_member_old[d]).sum()) >= int(maj_old[d])
+        if joint0[d]:
+            ok = ok and int((grants_row & q_member_new[d]).sum()) >= int(maj_new[d])
         return ok
 
     # ---- phase 0: delivery
@@ -222,6 +241,17 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     req_in = edge_ok.T & alive[:, None] & recv_up[None, :] & (mb["req_type"] != 0)[:, None]
     resp_in = edge_ok & recv_up[:, None] & alive[None, :] & (mb["resp_kind"] != 0)
 
+    # Heard-a-leader denial window (thesis 4.2.3; models/raft.py): shared by
+    # the log-carried membership defense (rcf) and the lease vote denial
+    # (rdl), bypassed per sender by the transfer override flag.
+    if rcf or rdl:
+        def rv_denied(src: int, d: int) -> bool:
+            clock_d = int(s["clock"][d]) + int(inp["skew"][d])
+            recent = clock_d - int(heard_clock[d]) < cfg.election_min_ticks
+            if xfr and int(mb["req_disrupt"][src]) != 0:
+                return False  # transfer-sanctioned election: always processed
+            return recent
+
     # ---- phase 1: term adoption
     saw_higher = np.zeros(n, bool)
     for d in range(n):
@@ -229,6 +259,15 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for src in range(n):
             # a PreVote probe's term is prospective: never adopted
             if req_in[src, d] and mb["req_type"][src] != REQ_PREVOTE:
+                if (
+                    rcf
+                    and mb["req_type"][src] == REQ_VOTE
+                    and rv_denied(src, d)
+                ):
+                    # 4.2.3 in full: a denied RequestVote is not PROCESSED --
+                    # no term adoption (the removed-server disruption
+                    # defense; under rdl alone adoption stays legal).
+                    continue
                 in_term = max(in_term, int(mb["req_term"][src]))
             if resp_in[d, src]:
                 in_term = max(in_term, int(mb["resp_term"][src]))
@@ -263,13 +302,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             )
             if up_to_date:
                 can.append(src)
-        if rdl:
-            # Lease vote denial (thesis 4.2.3; models/raft.py phase 2):
-            # a voter that heard from a current leader within the minimum
-            # election timeout on its LOCAL clock denies RequestVote.
-            clock_d = int(s["clock"][d]) + int(inp["skew"][d])
-            if clock_d - int(heard_clock[d]) < cfg.election_min_ticks:
-                can = []
+        if rcf or rdl:
+            # Heard-a-leader vote denial (thesis 4.2.3; models/raft.py
+            # phase 2): a voter that heard from a current leader within the
+            # minimum election timeout on its LOCAL clock denies
+            # RequestVote -- unless the sender carries the transfer
+            # override (rv_denied folds it in).
+            can = [src for src in can if not rv_denied(src, d)]
         if not can:
             continue
         if voted_for[d] != NIL:
@@ -327,6 +366,14 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                     log_len[d] = L
                 commit[d] = max(int(commit[d]), L)
                 snap_applied[d] = True
+                if rcf:
+                    # The snapshot carries its configuration context: the
+                    # sender's C_old/pending-toggle/entry-count at L, so the
+                    # receiver's derivation stays exact over config entries
+                    # it never saw (models/raft.py phase 3).
+                    base_mold[d] = np.asarray(mb["req_base_mold"][src], bool)
+                    base_pend[d] = int(mb["req_base_pend"][src])
+                    base_epoch[d] = int(mb["req_base_epoch"][src])
             a_ok_to[d] = src
             a_match[d] = L
             continue
@@ -347,6 +394,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         ent_t = [int(mb["ent_term"][src, min(j + k, e - 1)]) for k in range(e)]
         ent_v = [int(mb["ent_val"][src, min(j + k, e - 1)]) for k in range(e)]
         ent_tk = [int(mb["ent_tick"][src, min(j + k, e - 1)]) for k in range(e)]
+        ent_cf = [int(mb["ent_cfg"][src, min(j + k, e - 1)]) for k in range(e)]
 
         b = int(log_base[d])
         # prev below our base is committed-and-compacted: consistent by leader
@@ -376,6 +424,10 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             if track:
                 # The offer stamp replicates with the entry it tags.
                 log_tick[d, (prev_i + k) % cap] = ent_tk[k]
+            if rcf:
+                # The config command replicates beside the entry; non-config
+                # entries ship 0, scrubbing stale commands off reused slots.
+                log_cfg[d, (prev_i + k) % cap] = ent_cf[k]
         log_len[d] = new_len
 
         last_new = min(prev_i + n_acc, new_len)
@@ -393,9 +445,10 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # are quiet (not leader, no valid AE within the minimum election timeout).
     pv_out = np.zeros((n, n), bool)
     pv_grant = np.zeros((n, n), bool)
-    if rdl and not cfg.pre_vote:
-        # heard_clock maintenance for the lease vote denial (the pre-vote
-        # branch below maintains it when both gates are on).
+    if (rdl or rcf) and not cfg.pre_vote:
+        # heard_clock maintenance for the lease / removed-server vote
+        # denials (the pre-vote branch below maintains it when that gate is
+        # on too).
         for d in range(n):
             if has_ae[d]:
                 heard_clock[d] = int(s["clock"][d]) + int(inp["skew"][d])
@@ -467,7 +520,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 votes[d, src] = True
     win = np.zeros(n, bool)
     for d in range(n):
-        campaign_ok = role[d] == CANDIDATE and packed_quorum_row(votes[d]) and alive[d]
+        campaign_ok = role[d] == CANDIDATE and packed_quorum_row(d, votes[d]) and alive[d]
         if rcf and not member_b[d]:
             campaign_ok = False  # removed nodes cannot win on banked votes
         if campaign_ok or coup[d]:
@@ -494,7 +547,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 ):
                     votes[d, src] = True
             if (
-                packed_quorum_row(votes[d])
+                packed_quorum_row(d, votes[d])
                 and alive[d]
                 and not (rcf and not member_b[d])
             ):
@@ -548,10 +601,15 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         match = match_index[d].copy()
         match[d] = log_len[d]
         if rcf:
-            quorum_match = masked_qmatch(match, member_old, maj_old)
-            if joint0:
+            # Each leader's OWN derived configuration masks its commit
+            # quorum (tick-start rows; models/raft.py phase 5).
+            quorum_match = masked_qmatch(
+                match, q_member_old[d], int(maj_old[d])
+            )
+            if joint0[d]:
                 quorum_match = min(
-                    quorum_match, masked_qmatch(match, member_new, maj_new)
+                    quorum_match,
+                    masked_qmatch(match, q_member_new[d], int(maj_new[d])),
                 )
         else:
             quorum_match = int(np.sort(match)[::-1][cfg.quorum - 1])
@@ -560,46 +618,11 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         ) == term[d]:
             commit[d] = quorum_match
 
-    # ---- phase 5.2: reconfiguration admin (models/raft.py phase 5.2)
-    member_b2 = member_old | member_new if rcf else None
+    # ---- phase 5.2: reconfiguration transitions moved INTO the log
+    # (log-carried membership: no admin transition block -- config changes
+    # are appends in phase 6, each node's configuration re-derives from its
+    # own prefix at end of tick; models/raft.py phase 5.2 comment)
     xfer_pend = np.zeros(n, bool)
-    if rcf:
-        # Joint exit: a live member leader's commit covers the change point.
-        exit_j = joint0 and any(
-            role[d] == LEADER and alive[d] and member_b[d]
-            and int(commit[d]) >= cfg_pend - 1
-            for d in range(n)
-        )
-        if exit_j:
-            member_old = member_new.copy()
-            cfg_pend = 0
-            cfg_epoch += 1
-        joint2 = cfg_pend > 0
-        # Accept a membership toggle at the lowest-id live member leader.
-        memb_mid = member_old | member_new
-        lds = [
-            d for d in range(n) if role[d] == LEADER and alive[d] and memb_mid[d]
-        ]
-        t_r = int(inp["reconfig_cmd"])
-        if t_r != NIL and not joint2 and lds and 0 <= t_r < n:
-            toggled = member_new.copy()
-            toggled[t_r] = not toggled[t_r]
-            if int(toggled.sum()) >= 2:
-                ld = min(lds)
-                if cfg.joint_consensus:
-                    member_new = toggled
-                    cfg_pend = int(log_len[ld]) + 1
-                else:
-                    # TEST-ONLY mutant: one-step membership change.
-                    member_old = toggled.copy()
-                    member_new = toggled
-                cfg_epoch += 1
-        # Removed-leader stepdown (non-voting catch-up: learner from now on).
-        member_b2 = member_old | member_new
-        for d in range(n):
-            if not member_b2[d] and role[d] != FOLLOWER:
-                role[d] = FOLLOWER
-                leader_id[d] = NIL
     if xfr:
         for d in range(n):
             if xfer_to[d] != NIL:
@@ -613,11 +636,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         ld_ok = [
             d
             for d in range(n)
-            if role[d] == LEADER and alive[d] and not (rcf and not member_b2[d])
+            if role[d] == LEADER and alive[d] and not (rcf and not member_b[d])
         ]
         if t_x != NIL and ld_ok:
             ldx = min(ld_ok)
-            t_voter = member_new[t_x] if rcf else True
+            # Target must be a voter of the LEADER's own target config
+            # (per-node derived rows; tick-start like every config read).
+            t_voter = bool(q_member_new[ldx, t_x]) if rcf else True
             if t_x != ldx and t_voter and xfer_to[ldx] == NIL:
                 xfer_to[ldx] = t_x
         xfer_pend = xfer_to != NIL
@@ -630,7 +655,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 read_acks[d] |= aresp_pairs[d]
                 acks_eff = read_acks[d].copy()
                 acks_eff[d] = True
-                confirmed = packed_quorum_row(acks_eff)
+                confirmed = packed_quorum_row(d, acks_eff)
                 served = (confirmed if cfg.read_confirm else True) and alive[d]
                 if rdl and not served and alive[d]:
                     # Lease fast path (thesis 6.4.1; models/raft.py): a
@@ -644,7 +669,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                     )
                     fresh_row = np.asarray(ack_age[d] <= lease_w, bool).copy()
                     fresh_row[d] = True
-                    served = packed_quorum_row(fresh_row)
+                    served = packed_quorum_row(d, fresh_row)
+                    if xfr and xfer_pend[d]:
+                        # Transfer handoff covers the read path: the lease
+                        # fast path stops while a transfer pends
+                        # (models/raft.py phase 5).
+                        served = False
                 if served:
                     # serve (the latency metric rides StepInfo, which the
                     # oracle does not produce; parity pins the slot clears)
@@ -694,6 +724,25 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 base_term[d] = term_at_ring(
                     log_term[d], int(log_base[d]), int(base_term[d]), target
                 )
+                if rcf:
+                    # Fold the compacted span's config entries into the
+                    # snapshot context (models/cfglog.py fold_span): final
+                    # toggles into base_mold, the latest entry's jointness
+                    # into base_pend, the count into base_epoch. Runs before
+                    # phase 6 can reuse freed slots.
+                    span = [
+                        (a, int(log_cfg[d, (a - 1) % cap]))
+                        for a in range(int(log_base[d]) + 1, target + 1)
+                        if int(log_cfg[d, (a - 1) % cap]) != 0
+                    ]
+                    for _, code in span:
+                        if code < 0 or not cfg.joint_consensus:
+                            v = abs(code) - 1
+                            base_mold[d, v] = not base_mold[d, v]
+                    if span and cfg.joint_consensus:
+                        code_hi = span[-1][1]
+                        base_pend[d] = code_hi if code_hi > 0 else 0
+                    base_epoch[d] += len(span)
                 log_base[d] = target
 
     # ---- committed-prefix checksum (log_ops.chk_weights analogue): weights by
@@ -734,13 +783,54 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         retained = int(log_len[d]) - int(log_base[d])
         return retained < (cap - reserve if comp else cap)
 
-    def append(d, value, stamp):
+    def append(d, value, stamp, code=0):
         log_term[d, log_len[d] % cap] = term[d]
         log_val[d, log_len[d] % cap] = value
         if track:
             # Offer stamp beside the payload (no-ops/protocol filler: 0).
             log_tick[d, log_len[d] % cap] = stamp
+        if rcf:
+            # EVERY append writes the config plane (0 for non-config
+            # entries): a reused slot never leaks its old command.
+            log_cfg[d, log_len[d] % cap] = code
         log_len[d] += 1
+
+    # Config-entry origination (log-carried membership; models/raft.py
+    # phase 6): config changes are LOG WRITES sharing the one-append-per-
+    # node slot at priority no-op > config entry > client command, judged
+    # on each leader's OWN tick-start derived configuration.
+    cfg_write = np.zeros(n, bool)
+    cfg_code = np.zeros(n, np.int32)
+    if rcf:
+        t_r = int(inp["reconfig_cmd"])
+        ld_ok_c = [
+            d
+            for d in range(n)
+            if role[d] == LEADER and alive[d] and member_b[d]
+            and room_at(d) and not noop_at(d)
+        ]
+        # JOINT entry (+v+1): the admin's toggle, accepted by the lowest-id
+        # eligible leader whose own prefix is NOT already joint; refused
+        # when the toggle would leave C_new below 2 voters.
+        non_joint = [d for d in ld_ok_c if not joint0[d]]
+        if t_r != NIL and 0 <= t_r < n and non_joint:
+            d = min(non_joint)
+            toggled = q_member_new[d].copy()
+            toggled[t_r] = not toggled[t_r]
+            if int(toggled.sum()) >= 2:
+                cfg_write[d] = True
+                cfg_code[d] = t_r + 1
+        if cfg.joint_consensus:
+            # FINAL entry (-v-1): appended once the governing joint entry
+            # commits on the leader -- "C_old,new committed -> append C_new".
+            for d in ld_ok_c:
+                if joint0[d] and int(commit[d]) >= int(cfg_pend[d]):
+                    diff = q_member_old[d] ^ q_member_new[d]
+                    pend_v = int(np.argmax(diff))  # lowest differing bit
+                    cfg_write[d] = True
+                    cfg_code[d] = -(pend_v + 1)
+        # (cfg.joint_consensus False, TEST-ONLY single-server-change mutant:
+        # one final-acting entry per change, no completing entry.)
 
     if cfg.client_redirect:
         # K commands in flight chasing 302 redirects (raft.py phase 6): a fresh
@@ -760,6 +850,11 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for d in range(n):
             if noop_at(d):
                 append(d, NOOP, 0)
+                continue
+            if rcf and cfg_write[d]:
+                # Config entries carry value 0 and stamp 0 (the command
+                # rides the log_cfg plane); the slot is taken this tick.
+                append(d, 0, 0, int(cfg_code[d]))
                 continue
             here = [k for k in range(K) if pend[k] != NIL and tgt[k] == d]
             if (
@@ -787,6 +882,8 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         for d in range(n):
             if noop_at(d):
                 append(d, NOOP, 0)
+            elif rcf and cfg_write[d]:
+                append(d, 0, 0, int(cfg_code[d]))  # the slot holds a config entry
             elif (
                 cmd_in != NIL and role[d] == LEADER and alive[d] and room_at(d)
                 and not (xfr and xfer_pend[d])  # transfer lease handoff
@@ -810,7 +907,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             heartbeat[d] = True
             deadline[d] = clock[d] + cfg.heartbeat_ticks
         elif expired and cfg.pre_vote and (
-            not (rcf and not member_b2[d])  # non-voters never campaign
+            not (rcf and not member_b[d])  # non-voters never campaign
             and not (xfr and xfer_elect[d])  # thesis-3.10 pre-vote bypass
         ):
             # expiry starts a PRE-vote probe: no term bump, votedFor untouched
@@ -820,7 +917,7 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             votes[d, :] = False
             votes[d, d] = True
             deadline[d] = clock[d] + int(inp["timeout_draw"][d])
-        elif expired and not cfg.pre_vote and not (rcf and not member_b2[d]):
+        elif expired and not cfg.pre_vote and not (rcf and not member_b[d]):
             start_election[d] = True
             term[d] += 1
             role[d] = CANDIDATE
@@ -832,19 +929,24 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     if cfg.pre_vote:
         # real RequestVote broadcasts come from this tick's promotions
         start_election = pre_win.copy()
+    xe = np.zeros(n, bool)  # transfer-triggered elections (req_disrupt flag)
     if xfr:
         # TimeoutNow elections: the real-election start, bypassing timer and
         # pre-vote (~LEADER re-checked: a phase-4 win may have promoted).
         for d in range(n):
-            if xfer_elect[d] and role[d] != LEADER and not start_election[d]:
-                start_election[d] = True
-                term[d] += 1
-                role[d] = CANDIDATE
-                voted_for[d] = d
-                leader_id[d] = NIL
-                votes[d, :] = False
-                votes[d, d] = True
-                deadline[d] = clock[d] + int(inp["timeout_draw"][d])
+            if xfer_elect[d] and role[d] != LEADER:
+                if cfg.pre_vote and start_election[d]:
+                    continue  # kernel: xe = xfer_elect & ~pre_win & ~is_leader
+                xe[d] = True
+                if not start_election[d]:
+                    start_election[d] = True
+                    term[d] += 1
+                    role[d] = CANDIDATE
+                    voted_for[d] = d
+                    leader_id[d] = NIL
+                    votes[d, :] = False
+                    votes[d, d] = True
+                    deadline[d] = clock[d] + int(inp["timeout_draw"][d])
 
     # ---- phase 8: outbox (wire format v8: per-sender headers + per-edge offsets)
     z = lambda *shape: np.zeros(shape, np.int32)
@@ -864,6 +966,11 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "req_base_term": z(n),
         "req_base_chk": np.zeros(n, np.uint32),
         "xfer_tgt": np.full(n, NIL, np.int32),
+        "req_disrupt": z(n),
+        "ent_cfg": z(n, e),
+        "req_base_mold": np.zeros((n, n), bool),
+        "req_base_pend": z(n),
+        "req_base_epoch": z(n),
         "req_off": z(n, n),
         "resp_kind": z(n, n),
         "pv_grant": np.zeros((n, n), bool),
@@ -916,11 +1023,19 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             out["req_base"][src] = b
             out["req_base_term"][src] = bt
             out["req_base_chk"][src] = base_chk[src]
+            if comp and rcf:
+                # Snapshot config header: the sender's configuration context
+                # at its base rides every AE broadcast (models/raft.py).
+                out["req_base_mold"][src] = base_mold[src]
+                out["req_base_pend"][src] = base_pend[src]
+                out["req_base_epoch"][src] = base_epoch[src]
             for k in range(n_ship):
                 out["ent_term"][src, k] = log_term[src, (ws + k) % cap]
                 out["ent_val"][src, k] = log_val[src, (ws + k) % cap]
                 if track:
                     out["ent_tick"][src, k] = log_tick[src, (ws + k) % cap]
+                if rcf:
+                    out["ent_cfg"][src, k] = log_cfg[src, (ws + k) % cap]
             for dst in range(n):
                 if dst == src:
                     continue
@@ -945,6 +1060,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 if caught:
                     out["req_type"][src] = REQ_TIMEOUT_NOW
                     out["xfer_tgt"][src] = t
+    if xfr and (rcf or rdl):
+        # Disruptive-RequestVote override flag (thesis 3.10/4.2.3): set on
+        # transfer-triggered election broadcasts so heard-recent voters
+        # still process them. Written only when a denial gate can read it.
+        for src in range(n):
+            if xe[src]:
+                out["req_disrupt"][src] = 1
     # Responses travel back src<->dst: responder r answers requester q; the edge
     # plane carries only the type, payloads ride the per-responder fields above.
     for r in range(n):
@@ -966,6 +1088,71 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     lat_frontier = int(s["lat_frontier"])
     if track:
         lat_frontier = max(lat_frontier, int(commit.max()))
+
+    # ---- end-of-tick config derivation (log-carried membership): each
+    # node's effective configuration recomputed from its post-append,
+    # post-compaction log prefix (models/cfglog.py `derive`, scalar form).
+    # Apply-on-append and roll-back-on-truncation are the SAME recompute.
+    if rcf:
+        for d in range(n):
+            b = int(log_base[d])
+            horizon = (
+                int(log_len[d]) if cfg.act_on_append
+                # TEST-ONLY act-on-commit mutant: the COMMITTED prefix only.
+                else min(int(commit[d]), int(log_len[d]))
+            )
+            entries = [
+                (a, int(log_cfg[d, (a - 1) % cap]))
+                for a in range(b + 1, horizon + 1)
+                if int(log_cfg[d, (a - 1) % cap]) != 0
+            ]
+            m_old = base_mold[d].copy()
+            for _, code in entries:
+                if code < 0 or not cfg.joint_consensus:
+                    v = abs(code) - 1  # final toggles fold into C_old
+                    m_old[v] = not m_old[v]
+            d_epoch = int(base_epoch[d]) + len(entries)
+            if cfg.joint_consensus:
+                if entries:
+                    hi, pend_code = entries[-1]
+                else:
+                    # No live entry: the snapshot context rules (a pending
+                    # joint entry may sit at or below base).
+                    hi, pend_code = max(b, 1), int(base_pend[d])
+                if pend_code > 0:
+                    m_new = m_old.copy()
+                    m_new[pend_code - 1] = not m_new[pend_code - 1]
+                    d_pend = hi
+                else:
+                    m_new = m_old.copy()
+                    d_pend = 0
+            else:
+                m_new = m_old.copy()
+                d_pend = 0
+            d_hi = max(entries[-1][0] if entries else 0, b)
+            if not cfg.truncation_rollback and d_epoch < int(cfg_epoch[d]):
+                # TEST-ONLY ignore-truncation-rollback mutant: where the
+                # prefix LOST config entries, keep acting on the stale
+                # derived configuration (the demote check below still runs
+                # on the stale masks, mirroring the kernel).
+                m_old = member_old[d].copy()  # tick-start: untouched so far
+                m_new = member_new[d].copy()
+                d_pend = int(cfg_pend[d])
+                d_epoch = int(cfg_epoch[d])
+            member_old[d] = m_old
+            member_new[d] = m_new
+            cfg_pend[d] = d_pend
+            cfg_epoch[d] = d_epoch
+            # Removed-server stepdown (thesis 4.3): a leader whose own
+            # config union excludes it leads on until the removing entry
+            # commits on it; candidacies of removed nodes die immediately.
+            self_in = bool(m_old[d] or m_new[d])
+            is_cand = role[d] in (CANDIDATE, PRECANDIDATE)
+            if not self_in and (
+                (role[d] == LEADER and int(commit[d]) >= d_hi) or is_cand
+            ):
+                role[d] = FOLLOWER
+                leader_id[d] = NIL
 
     return {
         "role": role,
@@ -990,8 +1177,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "heard_clock": heard_clock,
         "member_old": member_old,
         "member_new": member_new,
-        "cfg_epoch": np.int32(cfg_epoch),
-        "cfg_pend": np.int32(cfg_pend),
+        "cfg_epoch": cfg_epoch,
+        "cfg_pend": cfg_pend,
+        "log_cfg": log_cfg,
+        "base_mold": base_mold,
+        "base_pend": base_pend,
+        "base_epoch": base_epoch,
         "xfer_to": xfer_to,
         "read_idx": read_idx,
         "read_tick": read_tick,

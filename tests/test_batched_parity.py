@@ -107,6 +107,42 @@ CONFIGS = [
         id="n5-prevote",  # thesis-9.6 probe rounds under churn
         marks=pytest.mark.slow,
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=1,
+            reconfig_interval=3,
+            drop_prob=0.25,
+            partition_period=8,
+            partition_prob=0.8,
+            crash_prob=0.5,
+            crash_period=14,
+            crash_down_ticks=8,
+        ),
+        id="n5-reconfig-truncation",  # log-carried configs under partition +
+        # crash churn: per-node derived member rows diverging and rolling
+        # back with truncations must match the vmap kernel bit-for-bit
+        # (tier-1: ISSUE-13 acceptance row -- the oracle pins the vmap form
+        # on the same config/seed family in test_oracle_parity.py)
+    ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            compact_margin=4,
+            client_interval=1,
+            reconfig_interval=5,
+            drop_prob=0.2,
+            crash_prob=0.5,
+            crash_period=20,
+            crash_down_ticks=12,
+        ),
+        id="n5-reconfig-compaction",  # config entries compacting away:
+        # fold_span snapshot-context advance + req_base_mold install on
+        # snapshot catch-up, vs the vmap kernel
+        marks=pytest.mark.slow,
+    ),
 ]
 
 

@@ -42,6 +42,22 @@ CORPUS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "corpus")
 ARTIFACTS = sorted(glob.glob(os.path.join(CORPUS_DIR, "*.json")))
 _IDS = [os.path.basename(p) for p in ARTIFACTS]
 
+# Budget re-tier (ISSUE 13): the checker-both-ways gates compile ~2 traced
+# replay programs PER artifact (~15-40s each on this tier's CPU), and the
+# corpus grew to five. Tier-1 keeps the checker gates for the newest
+# (log-carried) artifacts -- the ISSUE-13 acceptance pair, not yet covered
+# anywhere else -- while the three pre-v24 artifacts ride the slow tier:
+# their BIT-EXACT replay stays tier-1 via the one-command corpus replay
+# below (the "every hunted bug stays found" contract), and their checker
+# semantics are re-proven every CI run (trace smoke: weak-quorum; reconfig
+# smoke: blind-transfer hunt; lease smoke: lease-skew both ways).
+_TIER1_CHECKED = {"act-on-commit-n5.json", "single-server-change-n5.json"}
+_CHECKED_PARAMS = [
+    p if os.path.basename(p) in _TIER1_CHECKED
+    else pytest.param(p, marks=pytest.mark.slow)
+    for p in ARTIFACTS
+]
+
 
 def test_corpus_is_seeded():
     """The corpus exists and carries at least the three seed artifacts."""
@@ -105,7 +121,7 @@ def test_validator_rejects_provenance_free_artifact():
     assert any("mutant" in p for p in corpus_mod.validate_artifact(lying))
 
 
-@pytest.mark.parametrize("artifact", ARTIFACTS, ids=_IDS)
+@pytest.mark.parametrize("artifact", _CHECKED_PARAMS, ids=_IDS)
 def test_checker_rejects_mutant_replay_naming_its_property(artifact):
     """The six-property whole-history checker over the artifact's traced
     replay must REJECT the mutant kernel naming the provenance's recorded
@@ -125,7 +141,7 @@ def test_checker_rejects_mutant_replay_naming_its_property(artifact):
     assert rep.results[art["provenance"]["checker_property"]].witness
 
 
-@pytest.mark.parametrize("artifact", ARTIFACTS, ids=_IDS)
+@pytest.mark.parametrize("artifact", _CHECKED_PARAMS, ids=_IDS)
 def test_checker_passes_real_kernel_on_same_replay(artifact):
     """The REAL kernel under the identical (genome, seed, faults, horizon)
     must pass all six properties on a complete history: the corpus artifact

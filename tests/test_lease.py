@@ -208,10 +208,13 @@ def test_config_validator_pins_the_lease_bounds():
     with pytest.raises(AssertionError, match="offer-tick plane"):
         RaftConfig(n_nodes=5, read_interval=3, election_min_ticks=12,
                    read_lease_ticks=4)
-    with pytest.raises(AssertionError, match="mutually"):
-        RaftConfig(n_nodes=5, client_interval=4, read_interval=3,
-                   election_min_ticks=14, read_lease_ticks=4,
-                   transfer_interval=9)
+    # Leases + TimeoutNow transfers COEXIST since the disruptive-RequestVote
+    # override (ISSUE 13): the PR-11 mutual-exclusion validator is gone.
+    # The deterministic transfer-under-lease completion is pinned in
+    # tests/test_reconfig.py::test_transfer_overrides_lease_denial_*.
+    RaftConfig(n_nodes=5, client_interval=4, read_interval=3,
+               election_min_ticks=14, read_lease_ticks=4,
+               transfer_interval=9)
 
 
 def test_zero_cost_when_off_carry_contract():

@@ -225,6 +225,75 @@ def test_legacy_single_source_serve_still_broadcasts(tenanted):
     assert loop._serve_chunk._cache_size() == before
 
 
+def test_weighted_offer_scheduler_proportional_ops(tenanted):
+    """Per-tenant QoS (ROADMAP item 2's named follow-up, ISSUE 13): host-side
+    weights on the router's offer schedule. An idle max-weight tenant pins
+    the schedule's denominator (and keeps the weighted tenants below the
+    ring-compaction export horizon); the two write tenants at weights 2:1
+    then get offer ticks in EXACT Bresenham proportion, and their acked
+    ops/s land proportional within commit/export-lag tolerance. The chunk
+    program is reused untouched: weights only move NILs inside the packed
+    planes (data, never shapes)."""
+    import itertools
+
+    before = loop._serve_chunk._cache_size()
+    sess = ServeSession(
+        TCFG, batch=TB, seed=5, chunk=TCHUNK, window=TW, delta_depth=8,
+        warmup_ticks=TCHUNK,
+        tenants=[
+            Tenant("idle", 2, weight=8),
+            Tenant("heavy", 2, source=itertools.count(1), weight=2),
+            Tenant("light", 2, source=itertools.count(10_000_000), weight=1),
+        ],
+    )
+    sess.serve(chunks=3)
+    _idle, heavy, light = sess.router.tenants
+    assert loop._serve_chunk._cache_size() == before, (
+        "a weighting change forked the serve chunk program"
+    )
+    # Offer side: exact schedule proportionality (192 ticks packed; the
+    # Bresenham credit line gives weight w exactly T*w/8 of them).
+    ticks = 3 * TCHUNK
+    assert heavy.offered == (ticks * 2 // 8) * heavy.clusters
+    assert light.offered == (ticks * 1 // 8) * light.clusters
+    assert heavy.offered == 2 * light.offered
+    # Ack side: ops/s share follows the weight share (same wall clock, so
+    # the acked-count ratio IS the ops/s ratio). Tolerance covers the
+    # commits still in flight / undrained at the chunk-budget stop.
+    assert light.acked_values, "light tenant starved outright"
+    ratio = len(heavy.acked_values) / len(light.acked_values)
+    assert 1.5 <= ratio <= 2.5, (
+        f"acked ops not weight-proportional: {len(heavy.acked_values)} vs "
+        f"{len(light.acked_values)} (ratio {ratio:.2f}, weights 2:1)"
+    )
+    # No cross-tenant payload leakage under the weighted schedule.
+    assert all(0 < v < 10_000_000 for v in heavy.acked_values)
+    assert all(v >= 10_000_000 for v in light.acked_values)
+    with pytest.raises(ValueError, match="weight"):
+        Tenant("bad", 1, weight=0)
+
+
+def test_weighted_read_reoffer_never_starved():
+    """Regression (review finding): the read cadence counts the tenant's
+    ACTIVE ticks, not raw global phase. With weight 1 of w_max 2 the
+    Bresenham schedule activates odd global ticks only, and a global-phase
+    read_every=2 gate would select even ones -- empty intersection, reads
+    starved to zero forever. Host-only: the router's pack loop, no device."""
+    from raft_sim_tpu.serve.tenancy import TenantRouter
+
+    heavy = Tenant("heavy", 2, weight=2)
+    light = Tenant("light", 2, reads=10, read_every=2, weight=1)
+    r = TenantRouter([heavy, light], 4, True)
+    for _ in range(4):
+        _cmds, reads = r.pack(64)
+        assert reads is not None
+    assert light.reads_offered > 0, (
+        "weight-1 tenant's read re-offers starved by the weighted schedule"
+    )
+    # And the cadence still thins offers: at most every 2nd active tick.
+    assert light.reads_offered <= 4 * 64 // 2 * light.clusters
+
+
 def test_session_offer_read_acks_via_served_counter(tmp_path):
     """Session.offer_read -- the read-side Session.offer closing docs/
     SERVE.md's named follow-up. The ack is the served-read counter
