@@ -62,6 +62,7 @@ MATRIX_TICKS = {
     "config4": 300,
     "config4c": 300,
     "config5": 200,
+    "config5c": 200,
     "config6": 5_000,
     "config6r": 5_000,
 }
@@ -73,6 +74,7 @@ SMOKE_BATCH = {
     "config4": 256,
     "config4c": 256,
     "config5": 16,
+    "config5c": 16,
     "config6": 64,
     "config6r": 64,
 }
@@ -229,6 +231,10 @@ def bench(cfg: RaftConfig, batch: int, ticks: int, repeats: int = 3,
         "repeat_walls_s": [round(w, 4) for w in walls],
         "repeat_cv": steady_cv,
         "backend": jax.default_backend(),
+        # Carry layout of the benched config (cost_model.layout_of): the
+        # anchor/reconcile guards key on this so a compacted-layout row can
+        # never silently rebase the dense roofline (or vice versa).
+        "layout": "compact" if cfg.compact_planes else "dense",
         "batch": batch,
         "n_nodes": cfg.n_nodes,
         "ticks": ticks,
@@ -350,9 +356,12 @@ MEASUREMENT_SCHEMA = "measurement-pass-v1"
 
 # config3p rides beside config3 so PreVote's cost is a standing measured
 # delta (same N/batch/ticks; the only difference is the pre_vote gate).
+# config5c rides beside config5 the same way: the compacted-carry-layout
+# twin (ops/tile.py) -- the dense-vs-compacted layout A/B is a standing
+# measured delta, priced by the config5c cost pins before any chip run.
 MATRIX_CONFIGS = (
     "config1", "config2", "config3", "config3p", "config4", "config4c",
-    "config5", "config6", "config6r",
+    "config5", "config5c", "config6", "config6r",
 )
 
 
@@ -557,6 +566,28 @@ def measurement_pass(args) -> int:
     else:
         r05_notes.append("BENCH_r05.json not found: no pre-packing baseline")
 
+    # Dense-vs-compacted layout A/B (ISSUE 14): config5 and its compacted
+    # twin config5c run the SAME workload with bit-identical trajectories
+    # (tests/test_tile.py), so the throughput ratio prices the node-blocked
+    # tiling directly. Both rows ride the standing matrix; the pair is only
+    # assembled when both ran (a --configs subset may drop one).
+    if "config5" in matrix and "config5c" in matrix:
+        layout_ab = _ab_pair(
+            "config5: dense vs compacted carry layout (config5c)",
+            matrix["config5"], matrix["config5c"],
+            ["trajectories are bit-exact across the two arms (the layout is "
+             "physical only -- ops/tile.py); the cost pins predict the "
+             "compacted arm at ~0.64x the dense bytes/tick on config5 "
+             "(tests/golden_cost_model.json config5c/simulate)",
+             "neither arm can rebase the OTHER layout's roofline: rows carry "
+             "`layout` and the anchor/reconcile guards key on it"],
+        )
+    else:
+        layout_ab = {
+            "label": "config5: dense vs compacted carry layout",
+            "notes": ["skipped: --configs dropped config5 and/or config5c"],
+        }
+
     from raft_sim_tpu.obs import reconcile_matrix
 
     reconciliation = reconcile_matrix({"matrix": matrix},
@@ -587,6 +618,7 @@ def measurement_pass(args) -> int:
                 ["prices the v21 offer-tick plane carry the serve mode pays "
                  "(traffic_audit --serve has the static projection)"],
             ),
+            "layout_dense_vs_compact": layout_ab,
         },
         "reconciliation": reconciliation,
         "trajectory": trajectory,
@@ -703,6 +735,11 @@ def main() -> None:
             "config4",
             "config4c",
             "config5",
+            # The standing compacted-layout row: config5's exact workload
+            # under the ops/tile.py carry layout (bit-identical
+            # trajectories), so the dense-vs-compacted delta is measured
+            # beside its baseline every bench run -- the config3p pattern.
+            "config5c",
             "config6",
             "config6r",
         ]

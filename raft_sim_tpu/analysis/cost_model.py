@@ -201,10 +201,47 @@ def bench_anchor(root: str | None = None):
                 f"({v['scenario']}): ignored for the anchor"
             )
             continue
+        # A row measured under a different carry LAYOUT than the preset's
+        # current one must never rebase its roofline: the bytes/tick the
+        # anchor implies a rate against are the layout's (bench >= r14
+        # records `layout` per row; earlier rows are all dense). The
+        # PR 5/PR 8 smoke-row trap class, closed for layouts too.
+        if prod and (v.get("layout") or "dense") != layout_of(prod[0]):
+            notes.append(
+                f"{newest}: {k} row measured under the "
+                f"{v.get('layout') or 'dense'} layout (preset is "
+                f"{layout_of(prod[0])}): ignored for the anchor"
+            )
+            continue
         anchors[k] = float(v["cluster_ticks_per_s"])
     if not anchors:
         return {}, None, notes + [f"{newest}: no recoverable matrix rows"]
     return anchors, newest, notes
+
+
+def layout_of(cfg) -> str:
+    """Physical carry layout of a config: "compact" (ops/tile.py,
+    cfg.compact_planes) or "dense". Bench rows record this per row; the
+    anchor/reconcile guards key on it so a row measured under one layout can
+    never rebase the other layout's roofline."""
+    return "compact" if getattr(cfg, "compact_planes", False) else "dense"
+
+
+def dense_base(name: str) -> str | None:
+    """The dense-layout base preset of a compacted preset (config5c ->
+    config5): the preset whose config differs ONLY in compact_planes and
+    whose production batch matches. None for dense presets or when no base
+    exists."""
+    import dataclasses
+
+    entry = PRESETS.get(name)
+    if entry is None or not entry[0].compact_planes:
+        return None
+    want = dataclasses.replace(entry[0], compact_planes=False)
+    for other, (cfg, batch) in PRESETS.items():
+        if other != name and cfg == want and batch == entry[1]:
+            return other
+    return None
 
 
 def anchor(root: str | None = None):
@@ -577,6 +614,27 @@ def _derive_all(config_names: tuple) -> dict:
                 a * entry["bytes_per_tick_padded"], 1
             )
             entry["roofline_ticks_per_s"] = round(a, 1)
+    # Layout twins: a compacted tier (cfg.compact_planes) whose DENSE base
+    # preset is anchored inherits the base's implied HBM rate, so its pin
+    # carries a genuine layout PREDICTION (rate / own bytes) instead of the
+    # anchored tiers' by-construction drift detector. The anchor itself
+    # stays keyed by layout -- `bench_anchor` and obs/reconcile.py reject
+    # layout-mismatched rows -- so a compacted bench artifact can never
+    # silently rebase the dense roofline (the PR 5/PR 8 smoke-row trap
+    # class, closed for layouts too).
+    for key, entry in programs.items():
+        cfg_name, prog = key.split("/", 1)
+        if prog != "simulate" or "roofline_ticks_per_s" in entry:
+            continue
+        base = dense_base(cfg_name)
+        base_entry = programs.get(f"{base}/simulate") if base else None
+        rate = (base_entry or {}).get("implied_hbm_bytes_per_s")
+        if rate:
+            entry["layout_base"] = base
+            entry["implied_hbm_bytes_per_s"] = rate
+            entry["roofline_ticks_per_s"] = round(
+                rate / entry["bytes_per_tick_padded"], 1
+            )
     return {
         "jax_version": jax.__version__,
         "anchor_source": source,
@@ -837,6 +895,10 @@ def _pin_program(entry: dict) -> dict:
         "inputs_padded", "genome_padded", "bytes_per_tick_padded",
         "bytes_per_tick_logical", "live_peak", "temp_bytes",
         "anchor_ticks_per_s", "implied_hbm_bytes_per_s", "roofline_ticks_per_s",
+        # Layout-twin attribution: a compacted tier's roofline is a
+        # PREDICTION at its dense base's implied rate (not an anchored
+        # drift detector) -- the pin says whose rate it borrowed.
+        "layout_base",
     )
     return {k: entry[k] for k in keep if k in entry}
 

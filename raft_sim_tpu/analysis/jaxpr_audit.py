@@ -104,6 +104,9 @@ FORK_PAIRS: tuple[tuple[str, dict], ...] = (
     ("config3", {"heartbeat_ticks": 4, "ack_timeout_ticks": 16}),
     ("config4", {"drop_prob": 0.23, "clock_skew_prob": 0.13}),
     ("config5", {"partition_prob": 0.4}),
+    # Compacted layout tier: tuning knobs inside the layout gate must not
+    # fork (the pack/unpack boundary is shape-driven, never value-driven).
+    ("config5c", {"partition_prob": 0.4}),
     ("config6", {"crash_prob": 0.2, "drop_prob": 0.15}),
     ("config6r", {"client_interval": 8, "crash_down_ticks": 10}),
     # Reconfiguration plane: the admin cadences are tuning knobs (values stay
@@ -523,6 +526,14 @@ def check_carry_passthrough(
         "votes": jnp.dtype(jnp.uint32),
         "mb.pv_grant": jnp.dtype(jnp.uint32),
     }
+    if cfg.compact_planes:
+        # Compacted carry layout (ops/tile.py): the transformed legs ride
+        # flat uint32 word vectors -- the dense narrow dtypes live INSIDE
+        # the tick body (unpack at entry, repack at exit), so the carried
+        # avals are expected at the packed dtypes instead.
+        from raft_sim_tpu.ops import tile
+
+        expect.update(tile.packed_carry_dtypes(cfg))
     for nm, v in zip(names, carry_out):
         want = expect.get(nm)
         if want is not None and v.aval.dtype != want:
@@ -606,9 +617,13 @@ def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
 # TimeoutNow + ReadIndex legs live).
 # config9 adds the lease-read family (lease serve predicate, vote denial,
 # read_fr staleness leg -- compaction + offer-tick plane live too).
+# config5c adds the compacted-carry-layout family (ops/tile.py: the config5
+# workload with the per-edge planes bit-packed into flat uint32 legs) -- the
+# tier whose Pass C pin IS the layout's predicted bytes/tick verdict
+# (docs/PERF.md "the config5 roofline").
 AUDIT_CONFIGS = (
-    "config1", "config3", "config4", "config5", "config6", "config6r",
-    "config8", "config9",
+    "config1", "config3", "config4", "config5", "config5c", "config6",
+    "config6r", "config8", "config9",
 )
 
 

@@ -81,6 +81,14 @@ def step_pallas(
     b = s.role.shape[-1]
     if b % block_b:
         raise ValueError(f"batch {b} must be a multiple of block_b {block_b}")
+    if cfg.compact_planes:
+        # The compacted carry layout's pack/unpack boundary is reshape-heavy
+        # (ops/tile.py), and Mosaic cannot lower the unit-dim reshapes this
+        # kernel already avoids (log_ops.iota note in raft_batched.py) --
+        # the Pallas engine stays a dense-layout experiment.
+        raise NotImplementedError(
+            "step_pallas does not support cfg.compact_planes (dense layout only)"
+        )
 
     in_leaves, state_def = jax.tree.flatten(s)
     inp_leaves, inp_def = jax.tree.flatten(inp)

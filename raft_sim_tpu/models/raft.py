@@ -100,7 +100,25 @@ from raft_sim_tpu.utils.config import RaftConfig
 
 
 def step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
-    """Advance one cluster by one tick. Pure; jit/vmap/scan-safe."""
+    """Advance one cluster by one tick. Pure; jit/vmap/scan-safe.
+
+    Under cfg.compact_planes the carry arrives in the compacted layout
+    (ops/tile.py: per-edge value planes bit-packed into flat uint32 legs,
+    word/window planes flattened); this boundary unpacks to the dense
+    working view, runs the identical dense tick, and repacks -- gated-off
+    mailbox legs are passed through verbatim (`reuse`) so the
+    carry-passthrough contract holds exactly as in the dense layout.
+    Trajectories are bit-identical either way (tests/test_tile.py)."""
+    if not cfg.compact_planes:
+        return _step(cfg, s, inp)
+    from raft_sim_tpu.ops import tile
+
+    s2, info = _step(cfg, tile.unpack_state(cfg, s), tile.unpack_inputs(cfg, inp))
+    return tile.pack_state(cfg, s2, reuse=s), info
+
+
+def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
+    """The dense tick body (the layout-independent protocol semantics)."""
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
     track = cfg.track_offer_ticks  # static: offer-tick plane + latency metric active

@@ -64,7 +64,24 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     phase-order properties documented there bind both kernels, which
     tests/test_trace.py pins by re-deriving the batched path's device events
     from the unbatched kernel's stacked states).
+
+    Under cfg.compact_planes this boundary mirrors raft.step's: unpack the
+    compacted carry (ops/tile.py; trailing batch axes ride along), run the
+    identical dense tick, repack with gated-off legs passed through
+    verbatim.
     """
+    if not cfg.compact_planes:
+        return _step_b(cfg, s, inp)
+    from raft_sim_tpu.ops import tile
+
+    s2, info = _step_b(
+        cfg, tile.unpack_state(cfg, s), tile.unpack_inputs(cfg, inp)
+    )
+    return tile.pack_state(cfg, s2, reuse=s), info
+
+
+def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
+    """The dense batch-minor tick body (layout-independent semantics)."""
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
     track = cfg.track_offer_ticks  # static: offer-tick plane + latency metric active

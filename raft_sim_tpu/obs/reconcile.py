@@ -83,6 +83,21 @@ def non_anchor_reasons(config_name: str, row: dict,
         reasons.append(
             f"batch {row['batch']} != production {prod[1]}"
         )
+    if prod is not None:
+        # Layout keying (the PR 5/PR 8 trap class, closed for layouts): a
+        # row measured under one carry layout must never rebase the other
+        # layout's roofline -- a compacted A/B row labeled with the dense
+        # preset's name (or vice versa) reconciles but cannot anchor.
+        # Rows without a layout field (pre-r14) are all dense.
+        from raft_sim_tpu.analysis.cost_model import layout_of
+
+        row_layout = row.get("layout") or "dense"
+        if row_layout != layout_of(prod[0]):
+            reasons.append(
+                f"{row_layout} layout row vs the preset's "
+                f"{layout_of(prod[0])} layout: a layout A/B row can never "
+                "rebase the other layout's roofline"
+            )
     if prod is None:
         reasons.append(f"{config_name!r} is not a preset: no pins to rebase")
     return reasons

@@ -375,11 +375,17 @@ def make_inputs(
             cfg.read_interval, traced=False,
         )
 
+    deliver_mask = bitplane.pack(deliver, axis=1)
+    if cfg.compact_planes:
+        # Compacted layout (ops/tile.py): the word plane ships FLAT so the
+        # sublane tile stops padding its tiny word dim ([N, W] -> [N*W]; the
+        # kernels reshape back at tick entry). Same words, same bits.
+        deliver_mask = deliver_mask.reshape((-1,))
     return StepInputs(
         # Shipped bit-packed over the source axis (StepInputs docstring): the
         # same Bernoulli/partition draws, 32 edges per uint32 word -- the [N, N]
         # bool plane never leaves this function.
-        deliver_mask=bitplane.pack(deliver, axis=1),
+        deliver_mask=deliver_mask,
         skew=skew,
         timeout_draw=timeout_draw,
         client_cmd=client_cmd,

@@ -204,8 +204,13 @@ def extract(
         return (new.role == role_code) & (old.role != role_code)
 
     # Incoming-drop count per receiver: popcount of the packed delivery row
-    # (diagonal self-bit included in the mask, so delivered <= n).
-    delivered = bitplane.count(inp.deliver_mask, axis=1)  # [N(, B)]
+    # (diagonal self-bit included in the mask, so delivered <= n). Under the
+    # compacted layout the word plane ships flat ([N*W(, B)], ops/tile.py):
+    # restore the [N, W(, B)] row view first.
+    dm = inp.deliver_mask
+    if cfg.compact_planes:
+        dm = dm.reshape((n, -1) + dm.shape[1:])
+    delivered = bitplane.count(dm, axis=1)  # [N(, B)]
     dropped = jnp.int32(n) - delivered
     burst = dropped >= max(1, (n + 1) // 2)
 

@@ -268,7 +268,11 @@ class ClusterState(NamedTuple):
     # mailbox; log indices are capacity-bounded (int8 up to capacity 41, int16 up
     # to 4095 -- index_dtype) and ages saturate (ACK_AGE_SAT), cutting their HBM
     # traffic vs int32. Compaction configs carry absolute (unbounded) indices:
-    # int32.
+    # int32. Under cfg.compact_planes the CARRY form of these planes (and of
+    # req_off/resp_kind/votes/the entry windows/the delivery mask) is the
+    # bit-packed flat uint32 layout of ops/tile.py; the comments below state
+    # the dense contract the kernels compute on (tile.unpack_state at tick
+    # entry, pack_state at exit -- bit-identical trajectories either way).
     next_index: jax.Array  # [N, N] index_dtype; leader i's next index for peer j
     match_index: jax.Array  # [N, N] index_dtype
     # Ticks since leader i last received an AppendEntries response (success OR
@@ -570,7 +574,7 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
     n, cap = cfg.n_nodes, cfg.log_capacity
     idt = index_dtype(cfg)
     deadline = draw_timeouts(cfg, key, n)
-    return ClusterState(
+    state = ClusterState(
         role=jnp.full((n,), FOLLOWER, jnp.int32),
         term=jnp.ones((n,), jnp.int32),
         voted_for=jnp.full((n,), NIL, jnp.int32),
@@ -627,6 +631,16 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         now=jnp.int32(0),
         mailbox=empty_mailbox(cfg),
     )
+    if cfg.compact_planes:
+        # Compacted carry layout (ops/tile.py): the per-edge value planes
+        # ride bit-packed flat uint32 legs, the narrow word/window planes
+        # ride flattened. The field comments above document the DENSE
+        # contract (the kernels' working view; the layout tiers are priced
+        # by Pass C, not re-declared here).
+        from raft_sim_tpu.ops import tile
+
+        state = tile.pack_state(cfg, state)
+    return state
 
 
 def with_commit_chk(state: ClusterState) -> ClusterState:
@@ -644,3 +658,14 @@ def with_commit_chk(state: ClusterState) -> ClusterState:
 def init_batch(cfg: RaftConfig, key: jax.Array, batch: int) -> ClusterState:
     """[batch, ...] struct-of-arrays over independent clusters, each with its own seed."""
     return jax.vmap(lambda k: init_state(cfg, k))(jax.random.split(key, batch))
+
+
+def compact_twin(cfg: RaftConfig, on: bool = True) -> RaftConfig:
+    """`cfg` with the compacted carry layout toggled (ops/tile.py): the
+    layout A/B's one-knob twin -- trajectories are bit-identical either way,
+    only the physical carry form (and therefore the priced bytes/tick)
+    moves. Single-sourced here for bench, the traffic audit, and the parity
+    tests."""
+    import dataclasses
+
+    return dataclasses.replace(cfg, compact_planes=on)

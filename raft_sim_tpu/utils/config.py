@@ -207,6 +207,20 @@ class RaftConfig:
     # the read-side mirror of serve_ingest, and a structural gate like it.
     serve_reads: bool = False
 
+    # Compacted carry layout (ops/tile.py; docs/PERF.md "node-blocked
+    # tiling"). When True, the per-edge value planes
+    # (next/match/ack_age/req_off/resp_kind) are carried bit-packed to their
+    # config-bounded value ranges as flat uint32 word legs, and the narrow
+    # word/window planes (votes, the shared entry windows, the delivery
+    # mask) are carried flattened so the TPU sublane tile stops padding
+    # their minor dim. PHYSICAL layout only: both kernels unpack at tick
+    # entry and repack at exit, so trajectories are bit-identical with the
+    # dense layout (tests/test_tile.py) -- a structural gate like pre_vote
+    # (it changes which programs compile, never the protocol semantics).
+    # Under compaction the unbounded int32 index planes stay dense; the
+    # other legs still compact.
+    compact_planes: bool = False
+
     # PreVote (Raft thesis 9.6; BEYOND the reference, which has neither
     # pre-vote nor leadership transfer -- SURVEY.md 2.3.12). When True, an
     # expired node becomes a PRECANDIDATE and probes a majority at its
@@ -475,6 +489,26 @@ PRESETS: dict[str, tuple[RaftConfig, int]] = {
             # 16-tick sampling cadence (measured <= ~10% throughput cost).
             check_log_matching=True,
             log_matching_interval=16,
+        ),
+        10_000,
+    ),
+    # config5 under the compacted carry layout (ops/tile.py; ISSUE 14): the
+    # SAME workload, trajectories bit-identical (tests/test_tile.py), only
+    # the physical carry form moves -- the standing layout-A/B row that
+    # prices the node-blocked tiling against config5's dense wall
+    # (docs/PERF.md "the config5 roofline"). Priced by Pass C under its own
+    # tier; bench runs it beside config5 so the first chip session measures
+    # the layout delta with no extra flags.
+    "config5c": (
+        RaftConfig(
+            n_nodes=51,
+            log_capacity=16,
+            partition_period=32,
+            partition_prob=0.5,
+            check_invariants=True,
+            check_log_matching=True,
+            log_matching_interval=16,
+            compact_planes=True,
         ),
         10_000,
     ),

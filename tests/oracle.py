@@ -72,11 +72,62 @@ def chk_weights(k: int) -> tuple[int, int]:
     return w_t, w_v
 
 
-def state_to_dict(state) -> dict:
+def _bits_for(n_values: int) -> int:
+    """Bits to store values 0..n_values-1 (>= 1). Restates ops/tile.bits_for;
+    pinned against it in tests/test_constants.py."""
+    return max(1, (n_values - 1).bit_length())
+
+
+def unpack_values(words: np.ndarray, bits: int, count: int) -> np.ndarray:
+    """Independent numpy restatement of the compacted sub-byte layout
+    (ops/tile.py pack_words): k = 32 // bits values per uint32 word, value i
+    at word i // k, bit lane (i % k) * bits. Returns int64 values."""
+    words = np.asarray(words, np.uint32)
+    k = 32 // bits
+    i = np.arange(count)
+    return (
+        (words[i // k] >> np.uint32((i % k) * bits)) & np.uint32((1 << bits) - 1)
+    ).astype(np.int64)
+
+
+def _uncompact(cfg, d: dict) -> None:
+    """Undo the compacted carry layout (cfg.compact_planes) in place: the
+    per-edge value planes unpack from their bit-packed flat uint32 legs at
+    the independently restated widths (next/match: log indices bounded by
+    cap + 1, non-compaction only; ack_age: the saturation ceiling; req_off:
+    -1..E with a +1 bias; resp_kind: RESP_* 0..3), the word/window planes
+    reshape back from their flattened forms. The oracle's view -- and the
+    parity comparison domain -- stays the dense one either way."""
+    n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
+    w = (n + 31) // 32
+    mb = d["mailbox"]
+    idt = np.int8 if cap <= 41 else np.int16  # types.index_dtype, restated
+    adt = np.int8 if ack_age_sat(cfg) < 127 else np.int16  # types.ack_dtype
+    if cfg.compact_margin == 0:  # compaction carries dense absolute indices
+        ib = _bits_for(cap + 2)
+        d["next_index"] = unpack_values(d["next_index"], ib, n * n).astype(idt).reshape(n, n)
+        d["match_index"] = unpack_values(d["match_index"], ib, n * n).astype(idt).reshape(n, n)
+    d["ack_age"] = (
+        unpack_values(d["ack_age"], _bits_for(ack_age_sat(cfg) + 1), n * n)
+        .astype(adt).reshape(n, n)
+    )
+    mb["req_off"] = (
+        (unpack_values(mb["req_off"], _bits_for(e + 2), n * n) - 1)
+        .astype(np.int8).reshape(n, n)
+    )
+    mb["resp_kind"] = unpack_values(mb["resp_kind"], 2, n * n).astype(np.int8).reshape(n, n)
+    d["votes"] = d["votes"].reshape(n, w)
+    for f in ("ent_term", "ent_val", "ent_tick", "ent_cfg"):
+        mb[f] = mb[f].reshape(n, e)
+
+
+def state_to_dict(state, cfg=None) -> dict:
     """Host-side copy of a single-cluster ClusterState (device pytree -> numpy).
     Bit-packed planes (votes, mailbox pv_grant) are unpacked to [N, N] bool:
     the oracle's view -- and the parity tests' comparison domain -- stays the
-    dense boolean one."""
+    dense boolean one. States carried in the compacted layout
+    (cfg.compact_planes) need `cfg` so the restated bit widths can undo the
+    packing first."""
     d = {
         f: np.asarray(v)
         for f, v in zip(state._fields, state)
@@ -84,6 +135,8 @@ def state_to_dict(state) -> dict:
     }
     mb = state.mailbox
     d["mailbox"] = {f: np.asarray(v) for f, v in zip(mb._fields, mb)}
+    if cfg is not None and cfg.compact_planes:
+        _uncompact(cfg, d)
     n = d["role"].shape[0]
     d["votes"] = unpack_plane(d["votes"], n)
     d["mailbox"]["pv_grant"] = unpack_plane(d["mailbox"]["pv_grant"], n)
@@ -234,8 +287,13 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     # responses are [receiver, responder] packed words (direct).
     # A receiver must be alive now AND at send time (last tick): alive & ~restarted.
     # The delivery mask arrives bit-packed over the source axis; unpack to the
-    # dense [to, from] bool form the handler loops read.
-    edge_ok = unpack_plane(inp["deliver_mask"], n).copy()
+    # dense [to, from] bool form the handler loops read. Under the compacted
+    # layout (cfg.compact_planes) the word plane additionally ships FLAT
+    # ([N*W]): restore the [N, W] row view first.
+    dm = np.asarray(inp["deliver_mask"])
+    if dm.ndim == 1:
+        dm = dm.reshape(n, -1)
+    edge_ok = unpack_plane(dm, n).copy()
     np.fill_diagonal(edge_ok, False)
     recv_up = alive & ~restarted
     req_in = edge_ok.T & alive[:, None] & recv_up[None, :] & (mb["req_type"] != 0)[:, None]

@@ -38,8 +38,10 @@ def _golden():
         return json.load(f)
 
 # The acceptance tiers: derived-vs-hand agreement is asserted where the
-# roofline verdicts live (docs/PERF.md prints configs 3/4/5).
-AGREEMENT_CONFIGS = ("config3", "config4", "config5")
+# roofline verdicts live (docs/PERF.md prints configs 3/4/5; config5c is the
+# compacted-layout tier whose pin IS the ISSUE-14 bytes/tick verdict, so the
+# 1% cross-check covers the packed-leg pricing too).
+AGREEMENT_CONFIGS = ("config3", "config4", "config5", "config5c")
 
 
 # ------------------------------------------- derived vs eval_shape agreement

@@ -63,9 +63,12 @@ SNAPSHOT_PROGRAMS = (
 # config8 (the reconfiguration plane: membership/transfer/read legs live).
 # 11 adds config9 (lease-based reads: the lease serve predicate, vote
 # denial, and the read_fr staleness leg are structural).
-PINNED_STEP_LOWERINGS = 11
-PINNED_SCAN_LOWERINGS = 11
-PINNED_SCENARIO_SCAN_LOWERINGS = 11
+# 12 adds config5c (the compacted carry layout, ops/tile.py: pack/unpack at
+# the kernel boundary is a structural fork by design -- one program per
+# LAYOUT, never per tuning value, which the config5c fork pair pins).
+PINNED_STEP_LOWERINGS = 12
+PINNED_SCAN_LOWERINGS = 12
+PINNED_SCENARIO_SCAN_LOWERINGS = 12
 # The standing-fleet serve program (serve/loop.py simulate_serve): one program
 # per structurally distinct serve-mode config. Serve variants collapse the
 # scheduled cadence (client_interval -> 0), so presets differing ONLY in their
@@ -73,15 +76,17 @@ PINNED_SCENARIO_SCAN_LOWERINGS = 11
 # which is why this pin sits below the preset count. Command values are traced
 # data: a multi-chunk `driver serve` session compiles nothing after warmup.
 # (+ config3p / config8 serve variants: 7 -> 9; + config9's lease-read
-# serve variant: 10.)
-PINNED_SERVE_SCAN_LOWERINGS = 10
+# serve variant: 10; + config5c's compacted-layout serve variant: 11.)
+PINNED_SERVE_SCAN_LOWERINGS = 11
 # The protocol-trace program (telemetry windowed scan + event ring + coverage
 # legs, raft_sim_tpu/trace): at most one per preset -- these are "the pinned
 # trace variants" ISSUE 9's acceptance names: tracing adds ZERO step lowerings
 # (extraction is delta-based outside the kernels) and the coverage search's
 # generations all reuse one trace program (genomes are traced data; the
 # analyzer's trace fork pairs pin value-invariance).
-PINNED_TRACE_SCAN_LOWERINGS = 11  # + config3p/config8/config9 trace variants
+# + config3p/config8/config9 trace variants; + config5c's compacted-layout
+# trace variant (12).
+PINNED_TRACE_SCAN_LOWERINGS = 12
 
 
 def _pins():

@@ -84,6 +84,25 @@ CONFIGS = [
         # scenario: test_handlers.test_window_fallback_when_no_peer_responsive)
     ),
     pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            max_entries_per_rpc=2,  # narrow window: offsets/backpressure live
+            client_interval=1,
+            drop_prob=0.3,
+            crash_prob=0.5,
+            crash_period=20,
+            crash_down_ticks=10,
+            compact_planes=True,
+        ),
+        17,
+        id="n5-compact-crashes",  # the compacted carry layout (ops/tile.py)
+        # vs the oracle's independently restated unpacking, with crashes +
+        # heavy drop so conflict TRUNCATIONS and snapshot-free catch-up cross
+        # the compacted entry channel (bit-packed req_off offsets, flattened
+        # ent windows) every few ticks
+    ),
+    pytest.param(
         RaftConfig(n_nodes=3, log_capacity=8, compact_margin=4, client_interval=1),
         6,
         id="n3-compaction",  # 150 commands through an 8-slot ring: continuous
@@ -309,13 +328,13 @@ CONFIGS = [
 
 def run_parity(cfg, state, k_run, ticks):
     step = jax.jit(lambda s, i: raft.step(cfg, s, i)[0])
-    s_oracle = oracle.state_to_dict(state)
+    s_oracle = oracle.state_to_dict(state, cfg)
     for t in range(ticks):
         inp = faults.make_inputs(cfg, k_run, state.now)
         inp_np = {f: np.asarray(v) for f, v in zip(inp._fields, inp)}
         state = step(state, inp)
         s_oracle = oracle.oracle_step(cfg, s_oracle, inp_np)
-        assert_state_equal(oracle.state_to_dict(state), s_oracle, t)
+        assert_state_equal(oracle.state_to_dict(state, cfg), s_oracle, t)
     return state
 
 

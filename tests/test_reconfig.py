@@ -243,9 +243,15 @@ def test_reconfig_command_refused_while_joint_and_below_two_voters():
 @pytest.mark.parametrize(
     "n",
     [
-        5, 31, 32, 33,
-        # Slow tier: same packed arithmetic at W=2; the triplet pins the
-        # boundary in tier 1 (budget note on the dual-quorum test above).
+        5, 33,
+        # Slow tier (budget re-tier, ISSUE 14): 31/32 straddle the same
+        # 1->2-word boundary the tier-1 n=33 row crosses with the same
+        # packed arithmetic (the dual-quorum test above re-pins the word
+        # math per width), and 51 is the same arithmetic at W=2 -- each
+        # param is a step-compile pair the 870s tier-1 budget cannot
+        # absorb beside the ISSUE-14 layout tests.
+        pytest.param(31, marks=pytest.mark.slow),
+        pytest.param(32, marks=pytest.mark.slow),
         pytest.param(51, marks=pytest.mark.slow),
     ],
 )

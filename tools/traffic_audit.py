@@ -313,6 +313,25 @@ def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
         "packed_padded": pp,
         "boolfree_padded": a["boolfree_padded"],
     }
+    # Compacted-layout column (ops/tile.py, cfg.compact_planes): the SAME
+    # carry re-priced under the node-blocked tiling -- the per-edge value
+    # planes bit-packed to their config-bounded ranges, the word/window
+    # planes flattened past their sublane pads. Trajectories are
+    # bit-identical (tests/test_tile.py), so the projection is a pure
+    # layout-vs-layout bound at the same implied HBM rate.
+    if not cfg.compact_planes:
+        from raft_sim_tpu.types import compact_twin
+
+        c = audit(compact_twin(cfg), batch)
+        res |= {
+            "compact_logical": c["packed_logical"],
+            "compact_padded": c["packed_padded"],
+        }
+        print(
+            f"{'per-cluster-tick COMPACTED':28} {'':>14} "
+            f"{c['packed_logical']:>10,.0f} {c['packed_padded']:>10,.0f}",
+            file=out,
+        )
     if rec:
         bw = rec * dp
         ceiling = bw / pp
@@ -339,6 +358,15 @@ def report(name: str, cfg: RaftConfig, batch: int, top: int, out=sys.stdout,
             "compression can beat this",
             file=out,
         )
+        if "compact_padded" in res:
+            croof = bw / res["compact_padded"]
+            res["compact_roofline_ticks_per_s"] = croof
+            print(
+                f"COMPACTED roofline at that rate: {croof / 1e6:.2f}M ticks/s "
+                f"({croof / rec:.3f}x) -- the node-blocked layout's bound "
+                "(measure via the standing config5c bench row)",
+                file=out,
+            )
     if telemetry_ring is not None:
         # Observability overhead: the telemetry carry legs (window accumulator
         # always; ring buffer at depth K) priced against the packed tick.
