@@ -454,6 +454,84 @@ def _ab_pair(label: str, off_row: dict, on_row: dict, notes: list[str]) -> dict:
     }
 
 
+def _mesh_scaling_leg(args, smoke: bool, backend: str) -> dict:
+    """Strong-scaling sweep over the cluster mesh: the SAME global batch
+    sharded across 1/2/4/8 devices through parallel.simulate_windowed_sharded.
+    Trajectories are bit-identical at every width (keys split outside the
+    sharded region -- tests/test_farm_mesh.py), so the wall-clock ratio prices
+    the mesh partition, not the workload. Every row carries `n_devices`:
+    reconciliation and `cost_model.bench_anchor` reject D>1 rows the way they
+    reject layout mismatches (aggregate mesh throughput must never rebase the
+    single-device roofline), and on CPU every row is non-anchor anyway."""
+    from raft_sim_tpu.obs import reconcile
+    from raft_sim_tpu.parallel import make_mesh
+    from raft_sim_tpu.parallel import mesh as mesh_mod
+
+    name = args.mesh_preset
+    cfg, _ = PRESETS[name]
+    batch, ticks = _matrix_sizing(name, smoke)
+    batch = max(8, batch - batch % 8)  # one global batch, divisible at D=8
+    window = max(1, ticks // 4)
+    ticks = window * 4
+    avail = jax.device_count()
+    notes = [
+        f"fixed global batch {batch}: strong scaling -- the per-device slice "
+        "shrinks with D, the work does not",
+        "rows carry n_devices; D>1 rows are structurally non-anchor "
+        "(obs/reconcile + cost_model.bench_anchor reject them like layout "
+        "mismatches)",
+    ]
+    rows = {}
+    for d in (1, 2, 4, 8):
+        if d > avail:
+            notes.append(f"{d} devices > {avail} available: skipped")
+            continue
+        print(f"measurement mesh_scaling {name}: {d} devices...",
+              file=sys.stderr)
+        mesh = make_mesh(d)
+        t0 = time.perf_counter()
+        out = mesh_mod.simulate_windowed_sharded(cfg, 0, batch, ticks,
+                                                 window, mesh)
+        jax.block_until_ready(out[:3])
+        compile_s = time.perf_counter() - t0
+        walls = []
+        for _ in range(max(1, args.repeats)):
+            t0 = time.perf_counter()
+            out = mesh_mod.simulate_windowed_sharded(cfg, 0, batch, ticks,
+                                                     window, mesh)
+            jax.block_until_ready(out[:3])
+            walls.append(time.perf_counter() - t0)
+        best = min(walls)
+        row = {
+            "n_devices": d,
+            "batch": batch,
+            "ticks": ticks,
+            "window": window,
+            "smoke": smoke,
+            "backend": backend,
+            "compile_s": round(compile_s, 3),
+            "wall_s": round(best, 4),
+            "cluster_ticks_per_s": round(batch * ticks / best, 1),
+            "steady_ticks_per_s": round(batch * ticks / best, 1),
+        }
+        reasons = reconcile.non_anchor_reasons(name, row, backend)
+        row["anchor"] = not reasons
+        row["non_anchor_reasons"] = reasons
+        rows[f"{d}dev"] = row
+    base = (rows.get("1dev") or {}).get("cluster_ticks_per_s")
+    speedup = {
+        k: round(v["cluster_ticks_per_s"] / base, 3) if base else None
+        for k, v in rows.items()
+    }
+    return {
+        "label": f"{name}: one global batch across 1/2/4/8 devices",
+        "config": name,
+        "rows": rows,
+        "speedup_vs_1dev": speedup,
+        "notes": notes,
+    }
+
+
 def measurement_pass(args) -> int:
     """The owed measurement pass as ONE command (ISSUE 8 / ROADMAP item 1):
     the standing matrix plus the three unpriced deltas, reconciled against
@@ -491,6 +569,8 @@ def measurement_pass(args) -> int:
     ab_preset = args.ab_preset
     if ab_preset not in PRESETS:
         raise SystemExit(f"--ab-preset: unknown preset {ab_preset!r}")
+    if args.mesh_preset not in PRESETS:
+        raise SystemExit(f"--mesh-preset: unknown preset {args.mesh_preset!r}")
 
     matrix = {}
     for name in configs:
@@ -588,6 +668,8 @@ def measurement_pass(args) -> int:
             "notes": ["skipped: --configs dropped config5 and/or config5c"],
         }
 
+    mesh_scaling = _mesh_scaling_leg(args, smoke, backend)
+
     from raft_sim_tpu.obs import reconcile_matrix
 
     reconciliation = reconcile_matrix({"matrix": matrix},
@@ -620,6 +702,7 @@ def measurement_pass(args) -> int:
             ),
             "layout_dense_vs_compact": layout_ab,
         },
+        "mesh_scaling": mesh_scaling,
         "reconciliation": reconciliation,
         "trajectory": trajectory,
         "notes": traj_notes,
@@ -679,6 +762,11 @@ def main() -> None:
                     help="with --measurement-pass: the preset the fault-"
                          "lattice and serve-plane A/Bs run on (default "
                          "config3, the north-star workload)")
+    ap.add_argument("--mesh-preset", default="config3", metavar="NAME",
+                    help="with --measurement-pass: the preset the "
+                         "mesh_scaling leg strong-scales across 1/2/4/8 "
+                         "devices at one fixed global batch (default "
+                         "config3; D>1 rows are always non-anchor)")
     ap.add_argument("--serve", action="store_true",
                     help="bench ONLY the standing serve-throughput row "
                          "(commands+reads/s over a saturated multi-tenant "

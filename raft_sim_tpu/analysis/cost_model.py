@@ -81,7 +81,7 @@ from raft_sim_tpu.utils.config import PRESETS, RaftConfig
 # detection to the passes that actually ran).
 RULES = frozenset({
     "cost-carry-bytes", "cost-live-peak", "cost-donation", "cost-roofline",
-    "cost-golden",
+    "cost-golden", "cost-mesh-bytes",
 })
 
 # Drift tolerances (fractions) against the golden pins. The golden file can
@@ -211,6 +211,17 @@ def bench_anchor(root: str | None = None):
                 f"{newest}: {k} row measured under the "
                 f"{v.get('layout') or 'dense'} layout (preset is "
                 f"{layout_of(prod[0])}): ignored for the anchor"
+            )
+            continue
+        # A row measured across D>1 devices (bench >= r16 mesh_scaling leg
+        # records `n_devices` per row; every earlier row is single-device)
+        # reports AGGREGATE mesh throughput -- rebasing the single-device
+        # roofline onto it would inflate the implied HBM rate D-fold. Same
+        # trap class as layouts, closed for device counts.
+        if (v.get("n_devices") or 1) != 1:
+            notes.append(
+                f"{newest}: {k} row measured across {v['n_devices']} "
+                "devices: ignored for the anchor"
             )
             continue
         anchors[k] = float(v["cluster_ticks_per_s"])
@@ -586,6 +597,161 @@ def derive_program(key: str, closed, kind: str, cfg: RaftConfig, batch: int) -> 
     return entry
 
 
+# ------------------------------------------------------------- mesh pricing
+
+# The (preset, node-shard count) pairs the mesh section pins: the giant-N
+# tiers over the standing 8-way mesh (CI's forced 8-device CPU mesh; one
+# pod-slice row on hardware). A different device count changes ONLY n_pad --
+# re-derive with node_shard_model(name, D) for ad-hoc shapes.
+MESH_TIERS: tuple[tuple[str, int], ...] = (("config7", 8), ("config7x", 8))
+
+# Mailbox legs _gather_mailbox all_gathers (models/raft_batched.py) and the
+# config gate that turns each group on. Kept in sync by the derivation below
+# failing KeyError-loudly if a leg name leaves the carry, and by the mesh
+# parity/collective tests lowering the real program.
+_GATHERED_ALWAYS = (
+    "mb.req_type", "mb.req_term", "mb.req_commit", "mb.req_last_index",
+    "mb.req_last_term", "mb.ent_start", "mb.ent_prev_term", "mb.ent_count",
+    "mb.ent_term", "mb.ent_val", "mb.req_off", "mb.resp_kind", "mb.v_to",
+    "mb.a_ok_to", "mb.a_match", "mb.a_hint", "mb.resp_term",
+)
+
+
+def node_shard_model(name: str, n_devices: int) -> dict:
+    """Analytic per-device cost of the node-sharded program
+    (parallel/nodeshard.py) for one preset: the dense tier's moving carry legs
+    re-priced at the row-partitioned shapes (first node axis n -> nl = n_pad /
+    D, peer axes n -> n_pad), plus the all_gather traffic -- the bytes the
+    hot loop's one mailbox gather (and the invariants' leader gather)
+    materializes per cluster-tick, of which each device RECEIVES the
+    (D-1)/D off-device fraction over ICI. Pure shape arithmetic on the dense
+    twin's jaxpr: needs no devices, so the pins regenerate anywhere."""
+    import numpy as np
+
+    from raft_sim_tpu import types as rst_types
+    from raft_sim_tpu.parallel import nodeshard
+
+    cfg0, batch = PRESETS[name]
+    cfg = rst_types.compact_twin(cfg0, False)  # sharded carries run dense
+    n = cfg.n_nodes
+    n_pad = nodeshard.check_shardable(cfg, n_devices)
+    nl = n_pad // n_devices
+    cm = carry_model(jaxpr_audit.scan_jaxpr(cfg), batch)
+    axes_of = {f: a for f, (a, _) in nodeshard._STATE_PAD.items()}
+    axes_of.update(
+        {f"mb.{f}": a for f, (a, _) in nodeshard._MAILBOX_PAD.items()}
+    )
+
+    def shard_shape(nm: str, shape: list[int]) -> tuple[int, ...]:
+        out = list(shape)
+        for ax in axes_of.get(nm, ()):
+            out[ax] = nl if ax == 0 else n_pad
+        return tuple(out)
+
+    carry = 0.0
+    for nm, leg in cm["legs"].items():
+        if not leg["moving"]:
+            continue
+        isz = np.dtype(leg["dtype"]).itemsize
+        carry += 2 * policy.padded_bytes(shard_shape(nm, leg["shape"]), isz, batch)
+
+    gathered = list(_GATHERED_ALWAYS)
+    if cfg.track_offer_ticks:
+        gathered.append("mb.ent_tick")
+    if cfg.compaction:
+        gathered += ["mb.req_base", "mb.req_base_term", "mb.req_base_chk"]
+    if cfg.pre_vote:
+        gathered.append("mb.pv_grant")
+    ag = 0.0
+    legs_out = {}
+    for nm in gathered:
+        leg = cm["legs"][nm]
+        full = tuple(
+            n_pad if ax in axes_of[nm] else d
+            for ax, d in enumerate(leg["shape"])
+        )
+        b = policy.padded_bytes(full, np.dtype(leg["dtype"]).itemsize, batch)
+        legs_out[nm] = round(b, 1)
+        ag += b
+    if cfg.check_invariants:
+        # The election-safety leaders-by-term gather (_step_info_b).
+        b = policy.padded_bytes((n_pad,), 4, batch)
+        legs_out["leaders_by_term"] = round(b, 1)
+        ag += b
+
+    _, in_pad = input_bytes(cfg, batch)
+    entry = {
+        "n_nodes": n,
+        "n_devices": n_devices,
+        "n_pad": n_pad,
+        "nl": nl,
+        "per_device_carry_padded": round(carry, 1),
+        # Inputs are drawn redundantly on every device (zero communication);
+        # each device pays the full per-cluster input materialization.
+        "per_device_inputs_padded": in_pad,
+        "per_device_bytes_per_tick": round(carry + in_pad, 1),
+        "allgather_bytes_per_tick": round(ag, 1),
+        "ici_recv_bytes_per_tick": round(ag * (n_devices - 1) / n_devices, 1),
+        "gathered_legs": legs_out,
+    }
+    return entry
+
+
+def derive_mesh() -> dict:
+    return {
+        f"{name}@{d}dev": node_shard_model(name, d) for name, d in MESH_TIERS
+    }
+
+
+def compare_mesh(derived: dict, golden: dict, *, full: bool = True) -> list[Finding]:
+    """Mesh-section findings: per-device HBM bytes/tick and all_gather (ICI)
+    bytes/tick against the pins, carry-bytes tolerance both ways."""
+    out = []
+    g_mesh = golden.get("mesh") or {}
+    tol = _tol(golden, "carry_bytes")
+    keys = ("per_device_bytes_per_tick", "allgather_bytes_per_tick")
+    for key, d in derived.items():
+        g = g_mesh.get(key)
+        path = f"cost:mesh/{key}"
+        if g is None:
+            out.append(Finding(
+                rule="cost-golden", path=path,
+                message=f"mesh tier has no golden cost pin -- {_REGEN}",
+            ))
+            continue
+        for k in keys:
+            gv, dv = g.get(k), d.get(k)
+            if not gv or dv is None:
+                continue
+            if dv > gv * (1 + tol):
+                side = "ICI all_gather" if k.startswith("allgather") else "per-device HBM"
+                out.append(Finding(
+                    rule="cost-mesh-bytes", path=path,
+                    message=(
+                        f"{side} traffic regressed {gv:.0f} -> {dv:.0f} B per "
+                        f"cluster-tick (>{100 * tol:.0f}% tolerance): a leg "
+                        "widened or newly crosses the mesh -- "
+                        f"{_REGEN}"
+                    ),
+                ))
+            elif dv < gv * (1 - tol):
+                out.append(Finding(
+                    rule="cost-golden", path=path,
+                    message=(
+                        f"mesh {k} improved {gv:.0f} -> {dv:.0f} B: the pin is "
+                        f"stale -- {_REGEN} to lock in the win"
+                    ),
+                ))
+    if full:
+        for key in g_mesh:
+            if key not in derived:
+                out.append(Finding(
+                    rule="cost-golden", path=f"cost:mesh/{key}",
+                    message=f"golden pins a mesh tier no longer derived -- {_REGEN}",
+                ))
+    return out
+
+
 def derive_all(config_names=jaxpr_audit.AUDIT_CONFIGS) -> dict:
     """The full derived cost document for the audited tiers: one entry per
     program (the same zoo Pass A walks), plus the donation audit and the
@@ -641,6 +807,13 @@ def _derive_all(config_names: tuple) -> dict:
         "anchor_notes": notes,
         "donation": {k: dict(v) for k, v in donation_audit()},
         "programs": programs,
+        # Node-sharded tiers: derived only when every mesh preset is in the
+        # audited set (a --configs subset run prices what it audits).
+        "mesh": (
+            derive_mesh()
+            if all(name in config_names for name, _ in MESH_TIERS)
+            else {}
+        ),
     }
 
 
@@ -852,6 +1025,8 @@ def compare(derived: dict, golden: dict, *, full: bool = True) -> list[Finding]:
     out.extend(compare_donation(
         derived.get("donation", {}), golden.get("donation") or {}, full=full
     ))
+    if derived.get("mesh"):
+        out.extend(compare_mesh(derived["mesh"], golden, full=full))
     return out
 
 
@@ -930,6 +1105,7 @@ def update_golden(path: str | None = None,
             key: _pin_program(entry)
             for key, entry in sorted(derived["programs"].items())
         },
+        "mesh": derived.get("mesh") or {},
     }
     with open(path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)

@@ -59,7 +59,7 @@ from raft_sim_tpu.utils.config import PRESETS, RaftConfig
 # detection to the passes that actually ran).
 RULES = frozenset({
     "float-op", "plane-widening", "carry-dtype", "carry-passthrough",
-    "large-constant", "recompile-fork",
+    "large-constant", "recompile-fork", "node-collectives",
 })
 
 # Reduction primitives a widening convert may legally feed: the widened plane
@@ -607,6 +607,57 @@ def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
     return out
 
 
+def check_node_collectives(
+    name: str, cfg: RaftConfig, mesh, batch: int = _AUDIT_BATCH,
+    ticks: int = _AUDIT_TICKS,
+) -> list[Finding]:
+    """Rule node-collectives: the node-sharded program's ONLY inter-device
+    primitives are the whitelisted ones -- the tiled mailbox/invariant
+    all_gathers and the metric psum/pmin/pmax folds (parallel/nodeshard.py's
+    layout contract). A ppermute, all_to_all, or reduce_scatter sneaking into
+    the tick loop means a reduction stopped being receiver-local -- the exact
+    regression the row-partition layout exists to make impossible. Needs a
+    live multi-device "nodes" mesh to lower (the CI mesh-smoke job and
+    tests/test_nodeshard.py run under 8 forced CPU devices); callers gate on
+    device count, this function does not."""
+    from raft_sim_tpu.parallel import nodeshard
+
+    seed = jax.ShapeDtypeStruct((), jnp.int32)
+    closed = jax.make_jaxpr(
+        lambda s: nodeshard.simulate_node_sharded(cfg, s, batch, ticks, mesh)
+    )(seed)
+    seen = {
+        eqn.primitive.name
+        for eqn in iter_eqns(closed.jaxpr)
+        if eqn.primitive.name in NODE_COLLECTIVE_KINDS
+    }
+    bad = sorted(seen - NODE_COLLECTIVE_WHITELIST)
+    return [
+        Finding(
+            rule="node-collectives",
+            path=f"jaxpr:{name}/node_sharded_simulate",
+            message=(
+                f"node-sharded program lowered non-whitelisted collective(s) "
+                f"{bad}: the hot loop's inter-device traffic must stay the "
+                "mailbox/invariant all_gathers + metric psum/pmin/pmax folds "
+                "(parallel/nodeshard.py layout rules, docs/DESIGN.md)"
+            ),
+        )
+    ] if bad else []
+
+
+# Named-axis primitives the node-collectives rule classifies as inter-device
+# communication (axis_index is positional metadata, not traffic, but is
+# whitelisted explicitly so a future jax rename fails loudly as non-listed).
+NODE_COLLECTIVE_KINDS = frozenset({
+    "all_gather", "psum", "pmin", "pmax", "ppermute", "all_to_all",
+    "reduce_scatter", "pbroadcast", "pgather", "axis_index",
+})
+NODE_COLLECTIVE_WHITELIST = frozenset({
+    "all_gather", "psum", "pmin", "pmax", "axis_index",
+})
+
+
 # --------------------------------------------------------------- entry point
 
 # The config tiers Pass A audits by default: one per structural family --
@@ -621,9 +672,14 @@ def check_recompile_forks(pairs=FORK_PAIRS) -> list[Finding]:
 # workload with the per-edge planes bit-packed into flat uint32 legs) -- the
 # tier whose Pass C pin IS the layout's predicted bytes/tick verdict
 # (docs/PERF.md "the config5 roofline").
+# config7/config7x add the giant-N family (N=101 threshold-quorum and the
+# N=255 ceiling under the compacted layout): the single-chip programs audited
+# here, the per-device mesh bytes priced by Pass C's mesh section, and the
+# node-sharded program's collective whitelist checked whenever a multi-device
+# mesh is live (check_node_collectives).
 AUDIT_CONFIGS = (
     "config1", "config3", "config4", "config5", "config5c", "config6",
-    "config6r", "config8", "config9",
+    "config6r", "config7", "config7x", "config8", "config9",
 )
 
 
@@ -652,4 +708,15 @@ def run_pass(config_names=AUDIT_CONFIGS, fork_pairs=FORK_PAIRS) -> list[Finding]
                 )
             out.extend(check_large_constants(prog, closed))
     out.extend(check_recompile_forks(fork_pairs))
+    # The node-sharded program's collective whitelist, whenever this process
+    # can lower one (>= 2 devices: the CI mesh-smoke job and the test suite
+    # force 8 CPU devices; a single-device run skips it silently -- the gate
+    # still runs wherever the sharded program can actually exist).
+    if len(jax.devices()) >= 2 and "config7" in config_names:
+        from raft_sim_tpu.parallel import nodeshard
+
+        n_dev = 1 << (len(jax.devices()).bit_length() - 1)
+        mesh = nodeshard.make_node_mesh(n_dev)
+        cfg, _ = PRESETS["config7"]
+        out.extend(check_node_collectives("config7", cfg, mesh))
     return out

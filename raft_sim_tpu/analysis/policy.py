@@ -71,7 +71,7 @@ def padded_bytes(shape, itemsize: int, batch: int) -> float:
 # Dtype tokens legal in a types.py field comment: either a concrete dtype or
 # the name of a policy function in types.py that picks one per config.
 CONCRETE_DTYPES = ("bool", "int8", "int16", "int32", "int64", "uint8", "uint32")
-POLICY_DTYPES = ("index_dtype", "ack_dtype")
+POLICY_DTYPES = ("index_dtype", "ack_dtype", "node_dtype")
 
 # Leading-comment grammar: optional shape (`[N, W]` / `scalar`), one or more
 # dtype tokens separated by `/`, optionally a parenthesized policy name, then
@@ -161,7 +161,11 @@ def resolve_dtypes(spec: FieldSpec, cfg: RaftConfig) -> set[jnp.dtype]:
     tokens stand alone."""
     policy = [t for t in spec.dtypes if t in POLICY_DTYPES]
     if policy:
-        fns = {"index_dtype": rst_types.index_dtype, "ack_dtype": rst_types.ack_dtype}
+        fns = {
+            "index_dtype": rst_types.index_dtype,
+            "ack_dtype": rst_types.ack_dtype,
+            "node_dtype": rst_types.node_dtype,
+        }
         return {jnp.dtype(fns[t](cfg)) for t in policy}
     return {jnp.dtype(t) for t in spec.dtypes}
 

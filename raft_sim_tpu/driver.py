@@ -822,11 +822,21 @@ def _scenario_farm(args, ap) -> int:
             cfg = mutant_config(mutant, cfg)
         except ValueError as ex:
             ap.error(str(ex))
+    mesh = None
+    if args.mesh is not None:
+        from raft_sim_tpu.parallel import make_mesh
+
+        try:
+            mesh = make_mesh(args.mesh or None)
+        except ValueError as ex:
+            ap.error(str(ex))
     try:
         spec = FarmSpec(
             portfolio=parse_portfolio(args.portfolio),
             budget_gens=args.budget_gens,
-            population=args.population,
+            # Under --mesh the population scales with the device count:
+            # --population is the per-device share of the fleet.
+            population=args.population * (mesh.devices.size if mesh else 1),
             ticks=args.ticks,
             window=args.window,
             elite_frac=args.elite_frac,
@@ -838,7 +848,7 @@ def _scenario_farm(args, ap) -> int:
         with _profile_ctx(args.profile):
             res = run_farm(
                 cfg, spec, mutant=mutant, out_dir=args.out_dir,
-                corpus_dir=args.corpus_dir, freeze=args.freeze,
+                corpus_dir=args.corpus_dir, freeze=args.freeze, mesh=mesh,
             )
     except ValueError as ex:
         ap.error(str(ex))
@@ -1250,7 +1260,14 @@ def main(argv=None) -> int:
                        help="generation budget; exhausting it hitless pins "
                             "a negative result (out-dir/negative.json)")
     sfarm.add_argument("--population", type=int, default=64,
-                       help="TOTAL fleet batch, split among the members")
+                       help="fleet batch, split among the members; under "
+                            "--mesh this is the PER-DEVICE population (the "
+                            "total scales with the device count)")
+    sfarm.add_argument("--mesh", type=int, default=None, metavar="D",
+                       help="shard each generation over D devices (0 = all "
+                            "available): one shard_map'ped evaluation per "
+                            "generation, bit-identical hits at any device "
+                            "count (parallel.simulate_windowed_sharded)")
     sfarm.add_argument("--ticks", type=int, default=512)
     sfarm.add_argument("--window", type=int, default=64,
                        help="telemetry window (fitness resolution)")

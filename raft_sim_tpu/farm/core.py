@@ -227,6 +227,7 @@ def run_farm(
     corpus_dir: str | None = None,
     freeze: bool = False,
     perf=None,
+    mesh=None,
 ) -> FarmResult:
     """Run the portfolio hunt. `cfg` must already be the kernel under test
     (mutant_config-applied for mutant hunts; `mutant` labels artifacts and
@@ -244,8 +245,21 @@ def run_farm(
     kernel therefore re-pays one shrink per member per generation only to
     be dedup-rejected again; the default stop_on="hit" avoids that, and a
     per-run signature memo is the named follow-up if long mutant soaks
-    become a workflow."""
+    become a workflow.
+
+    `mesh` (a parallel.make_mesh 1-D cluster mesh) shards each generation's
+    evaluation over the devices (parallel.simulate_windowed_sharded):
+    population must divide by the device count. Trajectories -- and
+    therefore hits, coverage, and the manifest hash -- are BIT-IDENTICAL to
+    the unsharded farm at any device count (keys split outside the sharded
+    region), so the mesh is deliberately NOT part of the hashed identity:
+    provenance names the hunt, not the hardware it ran on."""
     spec = spec or FarmSpec()
+    if mesh is not None and spec.population % mesh.devices.size:
+        raise ValueError(
+            f"population {spec.population} must divide over the mesh's "
+            f"{mesh.devices.size} devices"
+        )
     portfolio = portfolio_mod.parse_portfolio(spec.portfolio)
     knobs = spec.knobs or search_mod.default_knobs(cfg)
     dim = len(knobs)
@@ -307,8 +321,23 @@ def run_farm(
         from raft_sim_tpu.obs import ChunkTimer
 
         perf = ChunkTimer(label="farm", batch=spec.population, sink=sink)
+    if mesh is not None:
+        from raft_sim_tpu.parallel import mesh as mesh_mod
+
+        evaluate = lambda g, s: mesh_mod.simulate_windowed_sharded(
+            run_cfg, s, spec.population, spec.ticks, spec.window, mesh,
+            genome=g, trace=trace_spec,
+        )
+        probe = ("parallel.simulate_windowed_sharded",
+                 mesh_mod.simulate_windowed_sharded)
+    else:
+        evaluate = lambda g, s: telemetry.simulate_windowed(
+            run_cfg, s, spec.population, spec.ticks, spec.window,
+            genome=g, trace=trace_spec,
+        )
+        probe = ("telemetry.simulate_windowed", telemetry.simulate_windowed)
     if perf is not None:
-        perf.add_probe("telemetry.simulate_windowed", telemetry.simulate_windowed)
+        perf.add_probe(*probe)
 
     gens: list[dict] = []
     hits: list[dict] = []
@@ -341,16 +370,10 @@ def run_farm(
         if perf is not None:
             perf.begin(spec.ticks)
         if trace_spec is None:
-            _, metrics, records, _ = telemetry.simulate_windowed(
-                run_cfg, sim_seed, spec.population, spec.ticks, spec.window,
-                genome=g,
-            )
+            _, metrics, records, _ = evaluate(g, sim_seed)
             tp = None
         else:
-            _, metrics, records, _, _, tp = telemetry.simulate_windowed(
-                run_cfg, sim_seed, spec.population, spec.ticks, spec.window,
-                genome=g, trace=trace_spec,
-            )
+            _, metrics, records, _, _, tp = evaluate(g, sim_seed)
         import jax
 
         if perf is not None:

@@ -95,6 +95,7 @@ from raft_sim_tpu.types import (
     Mailbox,
     StepInfo,
     StepInputs,
+    node_dtype,
 )
 from raft_sim_tpu.utils.config import RaftConfig
 
@@ -336,7 +337,7 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
     # grant resets the election deadline to clock + draw > clock, so the granter
     # cannot also expire).
     vr_out = is_rv
-    grant_to = jnp.where(granted_any, voted_for, NIL).astype(jnp.int8)  # [N]
+    grant_to = jnp.where(granted_any, voted_for, NIL).astype(node_dtype(cfg))  # [N]
 
     # ---- phase 3: AppendEntries requests (append-entries-handler, core.clj:105-123) --
     is_ae = req_in & (mb.req_type == REQ_APPEND)[:, None]  # [leader, follower]
@@ -520,7 +521,7 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
         a_ok = ae_ok
         out_a_match = jnp.where(ae_ok, last_new, 0)
     idt = s.next_index.dtype
-    out_a_ok_to = jnp.where(a_ok, ae_src, NIL).astype(jnp.int8)  # NIL = no success
+    out_a_ok_to = jnp.where(a_ok, ae_src, NIL).astype(node_dtype(cfg))  # NIL = no success
     out_a_match = out_a_match.astype(idt)  # bounded by the responder's log length
     out_a_hint = log_len.astype(idt)  # post-append, pre-injection (phase 6 rebinds)
 
@@ -1225,7 +1226,7 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
             caught = jnp.ones((n,), bool)
         fire = send_append & (xfer_to != NIL) & caught
         out_req_type = jnp.where(fire, REQ_TIMEOUT_NOW, out_req_type)
-        out_xfer_tgt = jnp.where(fire, xfer_to, NIL).astype(jnp.int8)
+        out_xfer_tgt = jnp.where(fire, xfer_to, NIL).astype(node_dtype(cfg))
     else:
         out_xfer_tgt = mb.xfer_tgt  # NIL, loop-invariant carry component
     if xfr and (rcf or rdl):

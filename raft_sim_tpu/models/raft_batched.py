@@ -16,9 +16,12 @@ semantic change lands in raft.py first (with its unit tests), then here.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from raft_sim_tpu.models import cfglog
 from raft_sim_tpu.ops import bitplane, log_ops
@@ -41,8 +44,90 @@ from raft_sim_tpu.types import (
     Mailbox,
     StepInfo,
     StepInputs,
+    node_dtype,
 )
 from raft_sim_tpu.utils.config import RaftConfig
+
+
+class NodeShardCtx(NamedTuple):
+    """Node-axis sharding context for `_step_b`/`_step_info_b` (built inside
+    parallel/nodeshard.py's shard_map body; never seen by single-chip callers).
+
+    The node axis is partitioned row-wise by RECEIVER over `n_dev` devices of a
+    named mesh axis: the global node count is padded to n_pad = n_dev * nl and
+    every state/mailbox leg carries this device's `nl` rows (peer/sender axes
+    stay full at n_pad). Pad rows are permanently-dead nodes (alive=False every
+    tick), which makes them tick fixed points; the pad hazards that are NOT
+    inert by liveness alone (the phase-8 window-start min and the n<=cap
+    quorum count) are masked explicitly where they arise -- see pad_self /
+    valid_peer below and docs/DESIGN.md "Node-axis sharding"."""
+
+    axis: str  # mesh axis name the node rows are sharded over
+    nl: int  # node rows per device (static)
+    n_pad: int  # padded node-axis length = n_devices * nl (static)
+    row0: jax.Array  # first global row of this shard (traced: axis_index * nl)
+
+
+def _loc(x, sh: NodeShardCtx):
+    """This device's node rows of a full [n_pad, ...] per-node array."""
+    return lax.dynamic_slice_in_dim(x, sh.row0, sh.nl, axis=0)
+
+
+def _gather_mailbox(cfg: RaftConfig, mb: Mailbox, sh: NodeShardCtx) -> Mailbox:
+    """THE hot-loop collective: all_gather the outbound mailbox over the node
+    axis and reorient the per-edge planes into the receiver view _step_b reads.
+
+    The sharded carry stores every mailbox leg WRITER-major (rows = this
+    device's senders/responders), so one tiled all_gather materializes the full
+    sender/responder axis and every delivery reduction after it is local:
+      req_* / ent_* headers [nl, ...] -> [n_pad, ...] (the broadcast row)
+      req_off [nl(snd), n_pad(rcv)]  -> gathered, then receivers keep their
+                                        local columns (dense orientation
+                                        [sender, receiver(local)])
+      resp_kind carried TRANSPOSED [nl(responder), n_pad(receiver)] -> gathered
+                                        to [n_pad, n_pad], swapped back to the
+                                        dense [receiver(local), responder] view
+      pv_grant carried [nl(voter), W(candidate bits)] -> unpacked over the
+                                        candidate axis, transposed, local
+                                        candidate rows repacked over the voter
+                                        axis (the dense [cand, W(voter)] view)
+    Legs whose structural gate is off in the sharded v1 surface (transfer,
+    reconfig, and -- when their own flags are off -- compaction/track/pre_vote
+    legs) stay the LOCAL loop-invariant carry: they are never read, and not
+    gathering them keeps the ICI bytes at the cost model's header-row figure."""
+    npd = sh.n_pad
+    ag = lambda x: lax.all_gather(x, sh.axis, axis=0, tiled=True)
+    comp, track = cfg.compaction, cfg.track_offer_ticks
+    if cfg.pre_vote:
+        pv = bitplane.unpack(ag(mb.pv_grant), npd, axis=1)  # [voter, cand, B]
+        pv = _loc(jnp.swapaxes(pv, 0, 1), sh)  # [nl(cand), n_pad(voter), B]
+        pv_grant = bitplane.pack(pv, axis=1)
+    else:
+        pv_grant = mb.pv_grant
+    return mb._replace(
+        req_type=ag(mb.req_type),
+        req_term=ag(mb.req_term),
+        req_commit=ag(mb.req_commit),
+        req_last_index=ag(mb.req_last_index),
+        req_last_term=ag(mb.req_last_term),
+        ent_start=ag(mb.ent_start),
+        ent_prev_term=ag(mb.ent_prev_term),
+        ent_count=ag(mb.ent_count),
+        ent_term=ag(mb.ent_term),
+        ent_val=ag(mb.ent_val),
+        ent_tick=ag(mb.ent_tick) if track else mb.ent_tick,
+        req_base=ag(mb.req_base) if comp else mb.req_base,
+        req_base_term=ag(mb.req_base_term) if comp else mb.req_base_term,
+        req_base_chk=ag(mb.req_base_chk) if comp else mb.req_base_chk,
+        req_off=lax.dynamic_slice_in_dim(ag(mb.req_off), sh.row0, sh.nl, axis=1),
+        resp_kind=_loc(jnp.swapaxes(ag(mb.resp_kind), 0, 1), sh),
+        pv_grant=pv_grant,
+        v_to=ag(mb.v_to),
+        a_ok_to=ag(mb.a_ok_to),
+        a_match=ag(mb.a_match),
+        a_hint=ag(mb.a_hint),
+        resp_term=ag(mb.resp_term),
+    )
 
 
 def to_batch_minor(tree):
@@ -55,7 +140,9 @@ def from_batch_minor(tree):
     return jax.tree.map(lambda x: jnp.moveaxis(x, -1, 0), tree)
 
 
-def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
+def step_b(
+    cfg: RaftConfig, s: ClusterState, inp: StepInputs, sh: NodeShardCtx | None = None
+) -> tuple[ClusterState, StepInfo]:
     """One tick for B clusters at once; every array carries a trailing batch axis.
 
     Mirrors raft.step phase by phase; see that function for the reference
@@ -71,7 +158,8 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     verbatim.
     """
     if not cfg.compact_planes:
-        return _step_b(cfg, s, inp)
+        return _step_b(cfg, s, inp, sh)
+    assert sh is None  # sharded carries run dense (parallel/nodeshard.py)
     from raft_sim_tpu.ops import tile
 
     s2, info = _step_b(
@@ -80,8 +168,20 @@ def step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSt
     return tile.pack_state(cfg, s2, reuse=s), info
 
 
-def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterState, StepInfo]:
-    """The dense batch-minor tick body (layout-independent semantics)."""
+def _step_b(
+    cfg: RaftConfig, s: ClusterState, inp: StepInputs, sh: NodeShardCtx | None = None
+) -> tuple[ClusterState, StepInfo]:
+    """The dense batch-minor tick body (layout-independent semantics).
+
+    `sh` (NodeShardCtx) switches to node-sharded execution inside a shard_map
+    over sh.axis: `s` carries this device's nl node rows (peer axes padded to
+    n_pad), `inp` carries the FULL padded per-node inputs (every device draws
+    them redundantly from the same keys -- zero communication), and the only
+    cross-device traffic per tick is the mailbox all_gather plus the
+    pmin/pmax/psum folds of the per-cluster [B] reductions. sh=None (every
+    single-chip caller) lowers a byte-identical program to the pre-sharding
+    kernel: the folds degenerate to identity and the orientation aliases below
+    collapse onto the one square eye."""
     n, e, cap = cfg.n_nodes, cfg.max_entries_per_rpc, cfg.log_capacity
     comp = cfg.compaction  # static: ring-log compaction + snapshot catch-up active
     track = cfg.track_offer_ticks  # static: offer-tick plane + latency metric active
@@ -94,11 +194,48 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
     # cannot lower unit-dim-appending reshapes, and this module doubles as the
     # pallas_engine kernel body.
     iota = log_ops.iota
-    ids2 = iota((n, 1), 0)  # [N, 1] node id column
-    eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)  # [N, N, 1]
-    eye_p3 = bitplane.eye(n)[:, :, None]  # [N, W, 1] packed self-bit rows
+    if sh is None:
+        nl = npd = n  # local self rows / padded peer-axis length: the full square
+        ids2 = iota((n, 1), 0)  # [N, 1] node id column
+        eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)  # [N, N, 1]
+        # Orientation aliases -- ONE array unsharded, distinct shapes sharded:
+        # eye_sr = [sender, receiver(local)] (delivery), eye_ls = [self(local),
+        # peer] (bookkeeping planes), pad_self = self-or-pad peer (the phase-8
+        # window min and anything else that must skip pad peers).
+        eye_sr = eye_ls = pad_self = eye3
+        eye_p3 = bitplane.eye(n)[:, :, None]  # [N, W, 1] packed self-bit rows
+        snd_ids = iota((n, n, 1), 0)  # [sender, receiver, 1] -> sender id
+        gmax = gmin = gsum = gany = lambda x: x  # node-axis folds: already local
+        alive_full = inp.alive
+    else:
+        # Sharded v1 feature surface: planes whose semantics span the node axis
+        # in ways the gather does not cover (client redirect routing, log-
+        # carried reconfig, transfer coups, ReadIndex/lease quorums, the O(N^2
+        # CAP) log-matching pairs) are excluded -- parallel/nodeshard.py raises
+        # a friendly error before tracing ever gets here.
+        assert not (rcf or xfr or rdx or rdl or cfg.client_redirect or cfg.check_log_matching)
+        nl, npd = sh.nl, sh.n_pad
+        ids2 = sh.row0 + iota((nl, 1), 0)  # [nl, 1] GLOBAL ids of local rows
+        peer3 = iota((nl, npd, 1), 1)  # [nl, n_pad, 1] -> peer id
+        eye_ls = ids2[:, :, None] == peer3
+        pad_self = eye_ls | (peer3 >= n)  # pad peers masked like self
+        eye_sr = iota((npd, nl, 1), 0) == (sh.row0 + iota((npd, nl, 1), 1))
+        eye_p3 = _loc(bitplane.eye(npd), sh)[:, :, None]  # [nl, W, 1]
+        snd_ids = iota((npd, nl, 1), 0)  # [sender, receiver(local), 1]
+        gmax = lambda x: lax.pmax(x, sh.axis)
+        gmin = lambda x: lax.pmin(x, sh.axis)
+        gsum = lambda x: lax.psum(x, sh.axis)
+        gany = lambda x: lax.psum(x.astype(jnp.int32), sh.axis) > 0
+        # Per-node inputs: keep the full alive vector (delivery gates need the
+        # SENDER side), localize the rest so the body below reads local rows.
+        alive_full = inp.alive
+        inp = inp._replace(
+            alive=_loc(inp.alive, sh),
+            restarted=_loc(inp.restarted, sh),
+            skew=_loc(inp.skew, sh),
+            timeout_draw=_loc(inp.timeout_draw, sh),
+        )
     zw = jnp.uint32(0)
-    snd_ids = iota((n, n, 1), 0)  # [sender, receiver, 1] -> sender id
 
     # ---- phase -1: restart (crash fault) -----------------------------------------
     # The snapshot triple is persistent: commit resumes at log_base (raft.py).
@@ -137,7 +274,10 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
         if rdl:
             # The staleness anchor dies with the slot it anchors.
             s = s._replace(read_fr=jnp.where(rs, 0, s.read_fr))
-    mb = s.mailbox
+    # In sharded mode the carry mailbox is writer-major local rows; the gather
+    # below is THE intra-tick collective (one tiled all_gather per leg), after
+    # which `mb` has the exact orientations the dense body reads.
+    mb = s.mailbox if sh is None else _gather_mailbox(cfg, s.mailbox, sh)
     base, bterm, bchk = s.log_base, s.base_term, s.base_chk  # [N, B]
     if rcf:
         # Snapshot config context (raft.py): carried untouched without comp.
@@ -174,18 +314,26 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
     # response orientation runs its AND-chain on the packed words and unpacks
     # once; the request orientation unpacks and transposes in bool space.
     dst_up = inp.alive & ~inp.restarted  # alive now AND at send time (last tick)
+    # Receiver-row slices of the (full, redundantly drawn) delivery mask; the
+    # packed source words cover all n_pad senders either way (pad bits are
+    # canonical zeros -- bitplane's contract).
+    dmask_rcv = inp.deliver_mask if sh is None else _loc(inp.deliver_mask, sh)
     resp_del_p = jnp.where(
         dst_up[:, None, :],
-        inp.deliver_mask & ~eye_p3 & bitplane.pack(inp.alive, axis=0)[None, :, :],
+        dmask_rcv & ~eye_p3 & bitplane.pack(alive_full, axis=0)[None, :, :],
         zw,
-    )  # [N, W, B]
-    deliver_resp = bitplane.unpack(resp_del_p, n, axis=1)
+    )  # [nl, W, B]
+    deliver_resp = bitplane.unpack(resp_del_p, npd, axis=1)
+    dreq = jnp.swapaxes(bitplane.unpack(inp.deliver_mask, npd, axis=1), 0, 1)
+    if sh is not None:
+        # [sender, receiver]: receivers keep their local columns.
+        dreq = lax.dynamic_slice_in_dim(dreq, sh.row0, nl, axis=1)
     deliver_req = (
-        jnp.swapaxes(bitplane.unpack(inp.deliver_mask, n, axis=1), 0, 1)
-        & ~eye3
-        & inp.alive[:, None, :]
+        dreq
+        & ~eye_sr
+        & alive_full[:, None, :]
         & dst_up[None, :, :]
-    )  # [N, N, B]
+    )  # [n_pad, nl, B]
     req_in = deliver_req & (mb.req_type != 0)[:, None, :]
     resp_in = deliver_resp & (mb.resp_kind != 0)
 
@@ -255,7 +403,7 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
     voted_for = jnp.where((voted_for == NIL) & granted_any, lowest, voted_for)
     vr_out = is_rv  # [candidate, voter] = response orientation [receiver, responder]
     # Grant target = post-update voted_for (raft.py phase 2: no reduction needed).
-    grant_to = jnp.where(granted_any, voted_for, NIL).astype(jnp.int8)  # [N, B]
+    grant_to = jnp.where(granted_any, voted_for, NIL).astype(node_dtype(cfg))  # [N, B]
 
     # ---- phase 3: AppendEntries requests ------------------------------------------
     is_ae = req_in & (mb.req_type == REQ_APPEND)[:, None, :]  # [leader, follower, B]
@@ -443,7 +591,7 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
         a_ok = ae_ok
         out_a_match = jnp.where(ae_ok, last_new, 0)
     idt = s.next_index.dtype
-    out_a_ok_to = jnp.where(a_ok, ae_src, NIL).astype(jnp.int8)  # NIL = no success
+    out_a_ok_to = jnp.where(a_ok, ae_src, NIL).astype(node_dtype(cfg))  # NIL = no success
     out_a_match = out_a_match.astype(idt)  # bounded by the responder's log length
     out_a_hint = log_len.astype(idt)  # post-append, pre-injection (phase 6 rebinds)
 
@@ -561,7 +709,7 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
 
     # ---- phase 5: leader commit advancement --------------------------------------
     is_leader = role == LEADER
-    match_with_self = jnp.where(eye3, len_i[:, None, :], match_index)  # [N, N, B]
+    match_with_self = jnp.where(eye_ls, len_i[:, None, :], match_index)  # [N, N, B]
     # quorum-th largest match without a sort (TPU sorts along a non-minor axis are
     # slow). Two equivalent counting forms; pick per static shapes:
     #   cap < n  (config5: N=51, CAP=16): match values are bounded by CAP, so count
@@ -605,6 +753,10 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
         ge = (
             match_with_self[:, None, :, :] >= match_with_self[:, :, None, :]
         )  # [N, j(candidate), k(counted), B]
+        if sh is not None:
+            # Pad peers carry match 0 and every candidate is >= 0: unmasked
+            # they would inflate the count by (n_pad - n) for every candidate.
+            ge = ge & (iota((1, 1, npd, 1), 2) < n)
         ok = jnp.sum(ge, axis=2) >= cfg.quorum  # [N, N, B]
         quorum_match = jnp.max(jnp.where(ok, match_with_self, 0), axis=1)  # [N, B]
     if comp:
@@ -736,21 +888,21 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
         cli = (log_tick_arr >= 1) & (log_tick_arr <= s.now[None, None, :])
         lm = (is_leader & inp.alive)[:, None, :] & newly & cli
         lats = jnp.where(lm, s.now[None, None, :] - log_tick_arr + 1, 0)  # [N, CAP, B]
-        lat_sum = jnp.sum(lats, axis=(0, 1)).astype(jnp.int32)
-        lat_cnt = jnp.sum(lm, axis=(0, 1)).astype(jnp.int32)
+        lat_sum = gsum(jnp.sum(lats, axis=(0, 1)).astype(jnp.int32))
+        lat_cnt = gsum(jnp.sum(lm, axis=(0, 1)).astype(jnp.int32))
         # Coverage gap counter: crossed-but-unattributed client entries, read
         # on the lowest-id max-commit node (raft.py for the full rationale).
-        is_maxc = commit == jnp.max(commit, axis=0)[None, :]
-        hnode = jnp.min(jnp.where(is_maxc, ids2, n), axis=0)  # [B]
+        is_maxc = commit == gmax(jnp.max(commit, axis=0))[None, :]
+        hnode = gmin(jnp.min(jnp.where(is_maxc, ids2, n), axis=0))  # [B]
         crossed = (ids2 == hnode[None, :])[:, None, :] & newly & cli
         lat_excluded = jnp.maximum(
-            jnp.sum(crossed, axis=(0, 1)).astype(jnp.int32) - lat_cnt, 0
+            gsum(jnp.sum(crossed, axis=(0, 1)).astype(jnp.int32)) - lat_cnt, 0
         )
         # Histogram bin = floor(log2(l)) (log_ops.log2_bin; raft.py).
         bin_ = log_ops.log2_bin(lats, LAT_HIST_BINS)
         oh_b = (iota((1, 1, LAT_HIST_BINS, 1), 2) == bin_[:, :, None, :]) & lm[:, :, None, :]
-        lat_hist = jnp.sum(oh_b, axis=(0, 1)).astype(jnp.int32)  # [BINS, B]
-        lat_frontier = jnp.maximum(s.lat_frontier, jnp.max(commit, axis=0))
+        lat_hist = gsum(jnp.sum(oh_b, axis=(0, 1)).astype(jnp.int32))  # [BINS, B]
+        lat_frontier = jnp.maximum(s.lat_frontier, gmax(jnp.max(commit, axis=0)))
     else:
         lat_sum = jnp.zeros_like(s.now)
         lat_cnt = jnp.zeros_like(s.now)
@@ -800,7 +952,9 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
         noop = win & (log_len - base < cap)
         room = log_len - base < cap - reserve
         # Win with no no-op room: surfaced as a liveness metric (raft.py).
-        noop_blocked = jnp.sum(win & ~(log_len - base < cap), axis=0).astype(jnp.int32)
+        noop_blocked = gsum(
+            jnp.sum(win & ~(log_len - base < cap), axis=0).astype(jnp.int32)
+        )
     else:
         noop = jnp.zeros_like(is_leader)
         room = log_len - base < cap
@@ -885,12 +1039,12 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
             client_ok = client_ok & ~cfg_write  # the slot holds a config entry
         if xfr:
             client_ok = client_ok & ~xfer_pend  # transfer lease handoff
-        wval_cl = jnp.broadcast_to(inp.client_cmd[None, :], (n, b))
+        wval_cl = jnp.broadcast_to(inp.client_cmd[None, :], (nl, b))
         # Direct mode accepts on the offer tick: stamp = now + 1 (raft.py).
         wtick_cl = (
-            jnp.broadcast_to((s.now + 1)[None, :], (n, b)) if track else None
+            jnp.broadcast_to((s.now + 1)[None, :], (nl, b)) if track else None
         )
-        cmds_cnt = jnp.any(client_ok, axis=0).astype(jnp.int32)  # offers, not appends
+        cmds_cnt = gany(jnp.any(client_ok, axis=0)).astype(jnp.int32)  # offers, not appends
         client_pend = s.client_pend
         client_dst = s.client_dst
         client_tick = s.client_tick
@@ -983,7 +1137,7 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
 
     # Request headers are per sender (both RPCs are broadcasts); only the AE window
     # offset is per edge (Mailbox docstring; raft.py phase 8).
-    ae_edge = send_append[:, None, :] & ~eye3
+    ae_edge = send_append[:, None, :] & ~eye_ls
     out_req_type = jnp.where(
         start_election, REQ_VOTE, jnp.where(send_append, REQ_APPEND, 0)
     )  # [N, B]
@@ -1009,7 +1163,7 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
             caught = jnp.ones_like(log_len, bool)  # TEST-ONLY mutant: no wait
         fire = send_append & (xfer_to != NIL) & caught
         out_req_type = jnp.where(fire, REQ_TIMEOUT_NOW, out_req_type)
-        out_xfer_tgt = jnp.where(fire, xfer_to, NIL).astype(jnp.int8)
+        out_xfer_tgt = jnp.where(fire, xfer_to, NIL).astype(node_dtype(cfg))
     else:
         out_xfer_tgt = mb.xfer_tgt  # NIL, loop-invariant carry component
     if xfr and (rcf or rdl):
@@ -1024,8 +1178,8 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
     responsive = ack_age <= cfg.ack_timeout_ticks
     if comp:
         big = jnp.int32(2**31 - 1)
-        ws_resp = jnp.min(jnp.where(eye3 | ~responsive, big, prev_out), axis=1)  # [N, B]
-        ws_all = jnp.min(jnp.where(eye3, big, prev_out), axis=1)
+        ws_resp = jnp.min(jnp.where(pad_self | ~responsive, big, prev_out), axis=1)  # [N, B]
+        ws_all = jnp.min(jnp.where(pad_self, big, prev_out), axis=1)
         ws = jnp.where(ws_resp == big, ws_all, ws_resp)
     else:
         # Single [N, N, B] min instead of two: unresponsive peers ride +K and
@@ -1037,7 +1191,10 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
         # as the two-pass form, one full reduction cheaper.
         K = jnp.asarray(cap + 1, len_i.dtype)
         z = jnp.asarray(0, len_i.dtype)
-        off = prev_out + jnp.where(eye3, K + K, jnp.where(responsive, z, K))
+        # Pad peers ride the self (+2K) lane: a leader's win resets the whole
+        # ack_age row, so they would otherwise pose as responsive (prev_out =
+        # len-at-win) and drag the window start (pad_self == eye3 dense).
+        off = prev_out + jnp.where(pad_self, K + K, jnp.where(responsive, z, K))
         m = jnp.min(off, axis=1)  # [N, B]
         ws = jnp.where(m >= K, m - K, m)
     ws = jnp.minimum(ws, len_i)  # narrow dtype throughout; widened at header writes
@@ -1086,7 +1243,12 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
         out_resp_kind = out_resp_kind + jnp.where(pv_out, RESP_PREVOTE, 0).astype(
             jnp.int8
         )
-        out_pv_grant = bitplane.pack(pv_grant, axis=1)  # [cand, W(bit=voter), B]
+        if sh is None:
+            out_pv_grant = bitplane.pack(pv_grant, axis=1)  # [cand, W(bit=voter), B]
+        else:
+            # Writer-major carry: the voter rows are local, candidates ride the
+            # packed bits; _gather_mailbox reorients on read.
+            out_pv_grant = bitplane.pack(jnp.swapaxes(pv_grant, 0, 1), axis=1)
     else:
         out_pv_grant = mb.pv_grant  # zeros, loop-invariant carry component
     if comp:
@@ -1129,7 +1291,10 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
             else mb.req_base_epoch
         ),
         req_off=out_req_off,
-        resp_kind=out_resp_kind,
+        # Sharded carries are writer-major: the responder rows are local, so the
+        # [resp-receiver, responder] plane is stored transposed (read path
+        # reorients in _gather_mailbox).
+        resp_kind=out_resp_kind if sh is None else jnp.swapaxes(out_resp_kind, 0, 1),
         pv_grant=out_pv_grant,
         v_to=grant_to,
         a_ok_to=out_a_ok_to,
@@ -1216,7 +1381,7 @@ def _step_b(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterS
     info = _step_info_b(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
-        reads_served, read_lat_sum, read_hist, viol_read_stale,
+        reads_served, read_lat_sum, read_hist, viol_read_stale, sh,
     )
     return new_state, info
 
@@ -1239,33 +1404,61 @@ def _step_info_b(
     read_lat_sum: jax.Array,
     read_hist: jax.Array,
     viol_read_stale: jax.Array,
+    sh: NodeShardCtx | None = None,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
     n = cfg.n_nodes
     b = new.role.shape[-1]
     iota = log_ops.iota
-    eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)
     is_leader = new.role == LEADER
     live_leader = is_leader & alive  # see raft._step_info: leadership metrics are live-only
     f = jnp.zeros((b,), bool)
+    if sh is None:
+        eye3 = iota((n, n, 1), 0) == iota((n, n, 1), 1)
+        ids1 = iota((n, 1), 0)
+        gmax = gmin = gsum = gany = lambda x: x  # node-axis folds: already local
+    else:
+        ids1 = sh.row0 + iota((sh.nl, 1), 0)
+        gmax = lambda x: lax.pmax(x, sh.axis)
+        gmin = lambda x: lax.pmin(x, sh.axis)
+        gsum = lambda x: lax.psum(x, sh.axis)
+        gany = lambda x: lax.psum(x.astype(jnp.int32), sh.axis) > 0
 
     if cfg.check_invariants:
-        pair_bad = (
-            is_leader[:, None, :]
-            & is_leader[None, :, :]
-            & (new.term[:, None, :] == new.term[None, :, :])
-            & ~eye3
-        )
+        if sh is None:
+            pair_bad = (
+                is_leader[:, None, :]
+                & is_leader[None, :, :]
+                & (new.term[:, None, :] == new.term[None, :, :])
+                & ~eye3
+            )
+        else:
+            # One extra [n_pad, B] gather: leaders encoded by term (terms start
+            # at 1, so 0 reads as non-leader; pad rows never lead). Tiny next
+            # to the mailbox gather, and only paid when invariants are on.
+            lv = lax.all_gather(
+                jnp.where(is_leader, new.term, 0), sh.axis, axis=0, tiled=True
+            )  # [n_pad, B]
+            pair_bad = (
+                (lv[:, None, :] > 0)
+                & (lv[:, None, :] == lv[None, :, :])
+                & ~(
+                    iota((sh.n_pad, sh.n_pad, 1), 0)
+                    == iota((sh.n_pad, sh.n_pad, 1), 1)
+                )
+            )
         viol_election = jnp.any(pair_bad, axis=(0, 1))
         # Committed-prefix immutability via the carried checksum (raft._step_info),
         # plus the compaction bounds (base <= commit, retained window <= CAP).
-        viol_commit = jnp.any(
-            (new.commit_index < old.commit_index)
-            | (new.commit_index > new.log_len)
-            | (new.commit_index < new.log_base)
-            | (new.log_len - new.log_base > cfg.log_capacity)
-            | ~chk_ok,
-            axis=0,
+        viol_commit = gany(
+            jnp.any(
+                (new.commit_index < old.commit_index)
+                | (new.commit_index > new.log_len)
+                | (new.commit_index < new.log_base)
+                | (new.log_len - new.log_base > cfg.log_capacity)
+                | ~chk_ok,
+                axis=0,
+            )
         )
     else:
         viol_election = f
@@ -1338,19 +1531,31 @@ def _step_info_b(
     else:
         viol_match, lm_skipped = f, jnp.zeros_like(new.now)
 
-    leader = jnp.min(jnp.where(live_leader, iota((n, 1), 0), n), axis=0)  # [B]
+    leader = gmin(jnp.min(jnp.where(live_leader, ids1, n), axis=0))  # [B]
+    if sh is None:
+        min_commit = jnp.min(new.commit_index, axis=0)
+    else:
+        # Pad rows sit at commit 0 forever; mask them to the max-int sentinel
+        # (a live row always exists, so the sentinel never wins).
+        min_commit = gmin(
+            jnp.min(
+                jnp.where(ids1 < n, new.commit_index, jnp.int32(2**31 - 1)), axis=0
+            )
+        )
     return StepInfo(
         viol_election_safety=viol_election,
         viol_commit=viol_commit,
         viol_log_matching=viol_match,
         leader=jnp.where(leader < n, leader, NIL).astype(jnp.int32),
-        n_leaders=jnp.sum(live_leader, axis=0).astype(jnp.int32),
-        max_term=jnp.max(new.term, axis=0),
-        max_commit=jnp.max(new.commit_index, axis=0),
-        min_commit=jnp.min(new.commit_index, axis=0),
-        msgs_delivered=(
-            jnp.sum(req_in, axis=(0, 1)) + jnp.sum(resp_in, axis=(0, 1))
-        ).astype(jnp.int32),
+        n_leaders=gsum(jnp.sum(live_leader, axis=0).astype(jnp.int32)),
+        max_term=gmax(jnp.max(new.term, axis=0)),
+        max_commit=gmax(jnp.max(new.commit_index, axis=0)),
+        min_commit=min_commit,
+        msgs_delivered=gsum(
+            (jnp.sum(req_in, axis=(0, 1)) + jnp.sum(resp_in, axis=(0, 1))).astype(
+                jnp.int32
+            )
+        ),
         cmds_injected=cmds_cnt,  # offers accepted, not appends; see raft.py phase 6
         lat_sum=lat_sum,
         lat_cnt=lat_cnt,

@@ -98,6 +98,16 @@ def non_anchor_reasons(config_name: str, row: dict,
                 f"{layout_of(prod[0])} layout: a layout A/B row can never "
                 "rebase the other layout's roofline"
             )
+    # Device-count keying (the same trap class as layouts, closed for the
+    # mesh-scaling leg): the pins price per-device bytes/tick, so a row
+    # measured across D devices reports aggregate throughput that a
+    # single-device roofline must never be rebased onto. Rows without an
+    # n_devices field (every pre-mesh artifact) are all single-device.
+    if (row.get("n_devices") or 1) != 1:
+        reasons.append(
+            f"row measured across {row['n_devices']} devices: aggregate "
+            "mesh throughput can never rebase the single-device roofline"
+        )
     if prod is None:
         reasons.append(f"{config_name!r} is not a preset: no pins to rebase")
     return reasons

@@ -5,15 +5,31 @@ from raft_sim_tpu.parallel.mesh import (
     init_distributed,
     make_mesh,
     simulate_sharded,
+    simulate_windowed_sharded,
     summarize,
+)
+from raft_sim_tpu.parallel.nodeshard import (
+    NODE_AXIS,
+    check_shardable,
+    make_node_mesh,
+    simulate_node_sharded,
+    simulate_node_sharded_windowed,
+    unshard_state,
 )
 
 __all__ = [
     "AXIS",
     "FleetSummary",
+    "NODE_AXIS",
+    "check_shardable",
     "gather_metrics",
     "init_distributed",
     "make_mesh",
+    "make_node_mesh",
+    "simulate_node_sharded",
+    "simulate_node_sharded_windowed",
     "simulate_sharded",
+    "simulate_windowed_sharded",
     "summarize",
+    "unshard_state",
 ]

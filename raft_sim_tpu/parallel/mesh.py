@@ -129,6 +129,84 @@ def simulate_sharded(cfg: RaftConfig, seed, batch: int, n_ticks: int, mesh: Mesh
     return sharded(keys_init, keys_run)
 
 
+def _run_shard_windowed(cfg, n_ticks, window, seg_len, trace_spec,
+                        keys_init, keys_run, genome):
+    """Per-shard body for `simulate_windowed_sharded`: init + the windowed
+    telemetry scan over the local cluster slice. The recorder leg is always
+    None here (the farm never rings) and is dropped from the return -- a
+    dead leg has no shard spec."""
+    from raft_sim_tpu.sim import telemetry
+
+    state = jax.vmap(lambda k: init_state(cfg, k))(keys_init)
+    out = telemetry.run_batch_minor_telemetry(
+        cfg, state, keys_run, n_ticks, window, None,
+        genome=genome, seg_len=seg_len, trace_spec=trace_spec,
+    )
+    if trace_spec is None:
+        final, metrics, recs, _ = out
+        return final, metrics, recs
+    final, metrics, recs, _, traws, tp = out
+    return final, metrics, recs, traws, tp
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 5, 7, 8))
+def simulate_windowed_sharded(
+    cfg: RaftConfig, seed, batch: int, n_ticks: int, window: int, mesh: Mesh,
+    genome=None, seg_len: int = 1, trace=None,
+):
+    """`telemetry.simulate_windowed` sharded over the cluster axis of `mesh`
+    -- the farm's per-generation evaluator (farm/core.py): one shard_map'ped
+    windowed scan for the whole CE portfolio, the population divided over
+    the devices. Same return shape as simulate_windowed (the recorder slot
+    is always None: rings are a debugging tool, the farm never arms one),
+    plus the trace legs when `trace` is given.
+
+    Bit-identical to the unsharded call at ANY device count: per-cluster
+    keys are split OUTSIDE the sharded region (the simulate_sharded
+    invariance pattern), so a hunt's trajectory -- and therefore its hits,
+    its manifest hash, its corpus artifacts -- never depends on the mesh it
+    ran on. Genome rows stay traced DATA ([B, S] leaves sharded over their
+    leading cluster axis): new genome values reuse the compiled program, so
+    the jit cache holds exactly one entry per (config, mesh) and stays flat
+    across generations and device counts (tests/test_farm.py pins this)."""
+    n_dev = mesh.devices.size
+    if batch % n_dev:
+        raise ValueError(f"batch {batch} must divide over {n_dev} devices")
+    root = jax.random.key(seed)
+    k_init, k_run = jax.random.split(root)
+    keys_init = _constrain_keys(jax.random.split(k_init, batch), mesh)
+    keys_run = _constrain_keys(jax.random.split(k_run, batch), mesh)
+
+    body = functools.partial(
+        _run_shard_windowed, cfg, n_ticks, window, seg_len, trace
+    )
+    args = (keys_init, keys_run)
+    in_specs = [P(AXIS), P(AXIS)]
+    if genome is None:
+        fn = lambda ki, kr: body(ki, kr, None)
+    else:
+        fn = body
+        args += (genome,)
+        in_specs.append(P(AXIS))  # [B, S] leaves: clusters lead, S replicated
+    # Batch-leading outputs shard on axis 0; the trace legs stay batch-minor
+    # (leaves [n_windows, ..., B] / [..., B]), so their specs put the cluster
+    # axis LAST -- ranks read off an eval_shape of the unsharded body.
+    out_specs = [P(AXIS), P(AXIS), P(AXIS)]
+    if trace is not None:
+        shapes = jax.eval_shape(fn, *args)
+        minor = lambda t: jax.tree.map(
+            lambda s: P(*([None] * (s.ndim - 1)), AXIS), t
+        )
+        out_specs += [minor(shapes[3]), minor(shapes[4])]
+    sharded = _shard_map(
+        fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=tuple(out_specs)
+    )
+    out = sharded(*args)
+    if trace is None:
+        return out[0], out[1], out[2], None
+    return out[0], out[1], out[2], None, out[3], out[4]
+
+
 def _constrain_keys(keys, mesh: Mesh):
     """Batch-shard a typed PRNG key array. The constraint is applied to the raw
     key DATA ([B, 2] uint32) and the keys re-wrapped: older jax (0.4.x) fails

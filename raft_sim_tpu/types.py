@@ -121,6 +121,21 @@ def index_dtype(cfg: RaftConfig):
     return jnp.int8 if cfg.log_capacity <= MAX_INT8_LOG_CAPACITY else jnp.int16
 
 
+# n_nodes ceiling for int8 node-id wire fields (Mailbox xfer_tgt/v_to/a_ok_to and
+# the kernels' grant_to/a_ok_to casts): ids 0..n-1 plus the NIL = -1 sentinel and
+# the `n` sentinel the min-select patterns use must all fit the dtype. 126 keeps
+# n itself (the sentinel) a valid int8 value with a slot to spare.
+MAX_INT8_NODES = 126
+
+
+def node_dtype(cfg: RaftConfig):
+    """Dtype of node-id wire fields (Mailbox xfer_tgt/v_to/a_ok_to): int8 up to
+    126 nodes, int16 for the giant-N tier (config7x, N=255). Node ids in the
+    STATE (voted_for/leader_id) stay int32 -- they are [N]-shaped headers, not
+    planes, so narrowing them buys nothing next to the [N, N] traffic."""
+    return jnp.int8 if cfg.n_nodes <= MAX_INT8_NODES else jnp.int16
+
+
 class Mailbox(NamedTuple):
     """In-flight RPC state, one tick deep. TPU-native wire format, v9 (+ the
     round-6 packed pre-vote grant bit-plane, checkpoint v18).
@@ -208,7 +223,7 @@ class Mailbox(NamedTuple):
     # untouched otherwise): the target of the sender's TimeoutNow broadcast
     # (REQ_TIMEOUT_NOW). Per sender like every request header -- a leader
     # fires at most one transfer per tick.
-    xfer_tgt: jax.Array  # [N(sender)] int8: TimeoutNow target node (NIL = none)
+    xfer_tgt: jax.Array  # [N(sender)] int8/int16 (node_dtype): TimeoutNow target node (NIL = none)
     # Disruptive-RequestVote flag (thesis 4.2.3's override, paired with
     # TimeoutNow in 3.10): set on the RequestVote broadcast of a transfer-
     # triggered election, so voters holding the heard-a-leader denial (live
@@ -235,8 +250,8 @@ class Mailbox(NamedTuple):
     req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E; -1 = snapshot
     resp_kind: jax.Array  # [N(receiver), N(responder)] int8 (RESP_*): response type per edge
     pv_grant: jax.Array  # [N(receiver), W] uint32: packed pre-vote grant bits (bit = responder)
-    v_to: jax.Array  # [N(responder)] int8: candidate granted this tick (NIL = none)
-    a_ok_to: jax.Array  # [N(responder)] int8: AE sender acked OK this tick (NIL = none)
+    v_to: jax.Array  # [N(responder)] int8/int16 (node_dtype): candidate granted this tick (NIL = none)
+    a_ok_to: jax.Array  # [N(responder)] int8/int16 (node_dtype): AE sender acked OK this tick (NIL = none)
     a_match: jax.Array  # [N(responder)] int16/int32 (index_dtype): acked index of the successful append
     a_hint: jax.Array  # [N(responder)] int16/int32 (index_dtype): nack hint (responder's log length)
     resp_term: jax.Array  # [N(responder)] int32: responder's term at send time
@@ -550,7 +565,7 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         req_base=i(n),
         req_base_term=i(n),
         req_base_chk=jnp.zeros((n,), jnp.uint32),
-        xfer_tgt=jnp.full((n,), NIL, jnp.int8),
+        xfer_tgt=jnp.full((n,), NIL, node_dtype(cfg)),
         req_disrupt=jnp.zeros((n,), jnp.int8),
         ent_cfg=i(n, e),
         req_base_mold=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
@@ -559,8 +574,8 @@ def empty_mailbox(cfg: RaftConfig) -> Mailbox:
         req_off=jnp.zeros((n, n), jnp.int8),
         resp_kind=jnp.zeros((n, n), jnp.int8),
         pv_grant=jnp.zeros((n, bitplane.n_words(n)), jnp.uint32),
-        v_to=jnp.full((n,), NIL, jnp.int8),
-        a_ok_to=jnp.full((n,), NIL, jnp.int8),
+        v_to=jnp.full((n,), NIL, node_dtype(cfg)),
+        a_ok_to=jnp.full((n,), NIL, node_dtype(cfg)),
         a_match=jnp.zeros((n,), index_dtype(cfg)),
         a_hint=jnp.zeros((n,), index_dtype(cfg)),
         resp_term=i(n),

@@ -245,8 +245,11 @@ class RaftConfig:
     log_matching_interval: int = 1
 
     def __post_init__(self):
-        # Node ids ride int8 wire fields (Mailbox v_to/a_ok_to) with NIL = -1.
-        assert 2 <= self.n_nodes <= 126
+        # Node ids ride node_dtype wire fields (Mailbox v_to/a_ok_to): int8 up
+        # to 126 nodes, int16 above (types.node_dtype). 255 is the validated
+        # giant-N ceiling (config7x, the node-sharded tier); past it nothing
+        # overflows int16, but no preset or test exercises the territory.
+        assert 2 <= self.n_nodes <= 255
         # Narrow-dtype wire/state bounds (types.py): log indices ride int16 planes
         # (next/match and the per-responder match/hint wire fields), the AE window
         # offset rides int8, and ack ages saturate below int16 max.
@@ -602,6 +605,43 @@ PRESETS: dict[str, tuple[RaftConfig, int]] = {
             clock_skew_prob=0.1,
         ),
         1_000,
+    ),
+    # Giant-N tier (node-axis sharding, parallel/nodeshard.py): one cluster
+    # too large for comfortable single-chip batches, partitioned row-wise
+    # across the mesh's "nodes" axis. N=101 keeps W=4 packed words and the
+    # threshold-quorum form (log_capacity < N), with client traffic + drops so
+    # replication is exercised at scale, not just elections. The feature set
+    # deliberately stays inside the sharded v1 surface (no reconfig/transfer/
+    # reads/redirect/log-matching); the same preset runs unsharded for the
+    # bit-exactness acceptance (tests/test_nodeshard.py).
+    "config7": (
+        RaftConfig(
+            n_nodes=101,
+            log_capacity=16,
+            max_entries_per_rpc=4,
+            client_interval=4,
+            drop_prob=0.05,
+        ),
+        1_000,
+    ),
+    # The N=255 ceiling tier (W=8 words, node ids at the int16 dtype tier):
+    # config7's workload at the largest supported cluster, under rolling
+    # partitions, carried in the COMPACTED layout (PR 14) on the single-chip
+    # path -- the node-sharded program runs the same preset dense internally
+    # (types.compact_twin; parallel/nodeshard.py), so one preset prices both
+    # the packed single-chip carry and the per-device mesh bytes.
+    "config7x": (
+        RaftConfig(
+            n_nodes=255,
+            log_capacity=16,
+            max_entries_per_rpc=4,
+            client_interval=4,
+            drop_prob=0.05,
+            partition_period=32,
+            partition_prob=0.25,
+            compact_planes=True,
+        ),
+        250,
     ),
     # config4's fault mix carrying client traffic, so offer->commit latency is
     # measured UNDER faults in the standing bench (not only on reliable nets).

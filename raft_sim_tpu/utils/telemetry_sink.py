@@ -574,6 +574,61 @@ def validate(directory: str) -> list[str]:
     return errors
 
 
+# --------------------------------------------------------------- multichip
+# The multi-chip proof artifact (MULTICHIP_r*.json, written by
+# tools/multihost_check.py --out): one diffable row per round instead of the
+# historical rc-only stub {n_devices, rc, ok}. `throughput_ticks_per_s` is
+# cluster-ticks/s of the sharded run on THIS machine (CPU rows are never
+# roofline anchors -- same rule as BENCH rows); `per_device_bytes_per_tick`
+# is the Pass C carry+inputs price of one device's cluster slice;
+# `parity_hash` is sha256 over the gathered metrics JSON, equal across the
+# multi-process run and the single-process reference when (and only when)
+# the trajectories matched bit-for-bit.
+MULTICHIP_SCHEMA = "multichip-v2"
+MULTICHIP_INT_FIELDS = ("n_devices", "n_processes", "batch", "ticks",
+                        "violations")
+MULTICHIP_BOOL_FIELDS = ("match",)
+MULTICHIP_FLOAT_FIELDS = ("throughput_ticks_per_s", "per_device_bytes_per_tick")
+MULTICHIP_STR_FIELDS = ("schema", "platform", "parity_hash")
+
+
+def validate_multichip(path: str) -> list[str]:
+    """Schema-check a MULTICHIP artifact ([] = valid). Legacy rc-only stubs
+    (no "schema" key) are reported as legacy, not silently passed."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as ex:
+        return [f"{path}: unreadable: {ex}"]
+    if "schema" not in doc:
+        return [f"{path}: legacy rc-only stub (pre-{MULTICHIP_SCHEMA}); "
+                "regenerate with tools/multihost_check.py --out"]
+    errors = []
+    if doc.get("schema") != MULTICHIP_SCHEMA:
+        errors.append(
+            f"{path}: schema {doc.get('schema')!r}, expected {MULTICHIP_SCHEMA}"
+        )
+    for k in MULTICHIP_INT_FIELDS:
+        if not isinstance(doc.get(k), int) or doc.get(k) is True:
+            errors.append(f"{path}: field {k!r} missing or non-int")
+    for k in MULTICHIP_BOOL_FIELDS:
+        if not isinstance(doc.get(k), bool):
+            errors.append(f"{path}: field {k!r} missing or non-bool")
+    for k in MULTICHIP_FLOAT_FIELDS:
+        v = doc.get(k)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"{path}: field {k!r} missing or not a non-negative number"
+            )
+    for k in MULTICHIP_STR_FIELDS:
+        if not isinstance(doc.get(k), str) or not doc.get(k):
+            errors.append(f"{path}: field {k!r} missing or empty")
+    ph = doc.get("parity_hash")
+    if isinstance(ph, str) and len(ph) != 64:
+        errors.append(f"{path}: parity_hash must be a sha256 hex digest")
+    return errors
+
+
 def read_windows(directory: str) -> list[dict]:
     """Load windows.jsonl as a list of dicts (validation is separate)."""
     with open(os.path.join(directory, "windows.jsonl")) as f:

@@ -1,0 +1,188 @@
+"""Batch-sharding the farm over the cluster mesh (parallel.simulate_windowed_sharded
++ farm/core.py `mesh`): every generation is ONE shard_map'ped windowed scan, and
+the hunt is BIT-IDENTICAL to the unsharded farm at any device count -- keys split
+outside the sharded region, so hits / coverage / manifest hash never depend on
+the hardware. The jit cache holds exactly one entry per (config, mesh): genome
+values are traced data, so generations never recompile."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from raft_sim_tpu import RaftConfig
+from raft_sim_tpu.farm import FarmSpec, run_farm
+from raft_sim_tpu.parallel import make_mesh
+from raft_sim_tpu.parallel import mesh as mesh_mod
+from raft_sim_tpu.sim import telemetry
+
+CFG = RaftConfig(n_nodes=5, client_interval=6, drop_prob=0.15, crash_prob=0.05,
+                 crash_period=32, crash_down_ticks=8)
+
+
+def _assert_tree_equal(a, b, tag=""):
+    la, lb = jax.tree.leaves(jax.device_get(a)), jax.tree.leaves(jax.device_get(b))
+    assert len(la) == len(lb)
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(x, y, err_msg=f"{tag}[{i}]")
+
+
+@pytest.mark.slow
+def test_windowed_sharded_parity():
+    """The evaluator alone: state + metrics + window records bit-equal to
+    telemetry.simulate_windowed over 8 devices (untraced, no genome).
+    Slow tier: the CI mesh-smoke job owns it; tier-1 keeps the wired-in
+    farm parity (test_farm_mesh_parity_and_flat_cache) instead."""
+    out_s = mesh_mod.simulate_windowed_sharded(CFG, 3, 16, 120, 30, make_mesh(8))
+    out_d = telemetry.simulate_windowed(CFG, 3, 16, 120, 30)
+    for tag, a, b in zip(("state", "metrics", "records"), out_s[:3], out_d[:3]):
+        _assert_tree_equal(a, b, tag)
+    assert out_s[3] is None  # the recorder slot (farms never ring)
+
+
+def test_farm_mesh_parity_and_flat_cache():
+    """The wired farm, tier-1 slice: an unguided scalar hunt over the 8-device
+    mesh produces the SAME generation rows, hits, and manifest hash as the
+    unsharded farm -- and the whole hunt costs ONE compile (genome rows are
+    traced data; the cache must not grow past the first generation). The
+    guided variant (trace plane + genome path live) rides the slow tier
+    below; CI mesh-smoke runs it every PR."""
+    spec = FarmSpec(portfolio=("scalar",), budget_gens=2, population=8,
+                    ticks=64, window=32, seed=11, guided=False,
+                    stop_on="budget")
+    r_d = run_farm(CFG, spec)
+    n0 = mesh_mod.simulate_windowed_sharded._cache_size()
+    r_s = run_farm(CFG, spec, mesh=make_mesh(8))
+    assert r_s.generations == r_d.generations
+    assert r_s.hits == r_d.hits
+    assert r_s.manifest["manifest_hash"] == r_d.manifest["manifest_hash"]
+    # One (config, mesh) program for the whole hunt, not one per generation.
+    assert mesh_mod.simulate_windowed_sharded._cache_size() == n0 + 1
+
+
+@pytest.mark.slow
+def test_farm_mesh_guided_parity_and_flat_cache():
+    """The guided hunt (trace plane + genome path live) over the mesh:
+    identical rows, hits, manifest hash AND coverage bits vs the unsharded
+    farm, still one compile for the whole hunt."""
+    spec = FarmSpec(portfolio=("scalar", "coverage"), budget_gens=2,
+                    population=16, ticks=128, window=32, seed=11,
+                    stop_on="budget")
+    r_d = run_farm(CFG, spec)
+    n0 = mesh_mod.simulate_windowed_sharded._cache_size()
+    r_s = run_farm(CFG, spec, mesh=make_mesh(8))
+    assert r_s.generations == r_d.generations
+    assert r_s.hits == r_d.hits
+    assert r_s.manifest["manifest_hash"] == r_d.manifest["manifest_hash"]
+    assert r_s.manifest["cov_bits_total"] == r_d.manifest["cov_bits_total"]
+    assert mesh_mod.simulate_windowed_sharded._cache_size() == n0 + 1
+
+
+def test_farm_rejects_indivisible_population():
+    with pytest.raises(ValueError, match="divide over"):
+        run_farm(CFG, FarmSpec(population=10, budget_gens=1),
+                 mesh=make_mesh(8))
+
+
+@pytest.mark.slow
+def test_farm_device_count_invariance():
+    """1/2/4/8 devices: identical hunt rows at every width, one cache entry
+    per mesh (the device-count axis adds programs, generations never do)."""
+    spec = FarmSpec(portfolio=("scalar",), budget_gens=2, population=16,
+                    ticks=96, window=32, seed=7, guided=False,
+                    stop_on="budget")
+    base = run_farm(CFG, spec).generations
+    n0 = mesh_mod.simulate_windowed_sharded._cache_size()
+    for i, d in enumerate((1, 2, 4, 8), start=1):
+        r = run_farm(CFG, spec, mesh=make_mesh(d))
+        assert r.generations == base, f"{d} devices diverged"
+        assert mesh_mod.simulate_windowed_sharded._cache_size() == n0 + i
+
+
+# ------------------------------------------- device-count-keyed anchor guard
+
+
+def test_bench_anchor_rejects_device_count_mismatched_rows(tmp_path):
+    """A mesh_scaling row (n_devices > 1) reports AGGREGATE mesh throughput
+    and must never rebase the single-device roofline anchor -- the same trap
+    class bench_anchor already closes for layouts. Rows without the field
+    (every pre-mesh artifact) are single-device and still anchor; an explicit
+    n_devices=1 row anchors too."""
+    import json
+
+    from raft_sim_tpu.analysis import cost_model
+
+    doc = {
+        "matrix": {
+            "config3": {"cluster_ticks_per_s": 320e6, "batch": 100_000,
+                        "n_devices": 8},
+            "config4": {"cluster_ticks_per_s": 23e6, "batch": 100_000,
+                        "n_devices": 1},
+            "config5": {"cluster_ticks_per_s": 9e6, "batch": 10_000},
+        }
+    }
+    (tmp_path / "BENCH_r99.json").write_text(json.dumps(doc))
+    anchors, source, notes = cost_model.bench_anchor(str(tmp_path))
+    assert "config3" not in anchors
+    assert anchors == {"config4": 23e6, "config5": 9e6}
+    assert any("config3" in n and "devices" in n for n in notes)
+
+
+def test_reconcile_marks_device_count_mismatch_non_anchor():
+    from raft_sim_tpu.obs import reconcile
+
+    row = {"steady_ticks_per_s": 320e6, "batch": 100_000, "n_devices": 8}
+    reasons = reconcile.non_anchor_reasons("config3", row, "tpu")
+    assert any("single-device roofline" in r for r in reasons)
+    one = {"steady_ticks_per_s": 40e6, "batch": 100_000, "n_devices": 1}
+    assert reconcile.non_anchor_reasons("config3", one, "tpu") == []
+    legacy = {"steady_ticks_per_s": 40e6, "batch": 100_000}
+    assert reconcile.non_anchor_reasons("config3", legacy, "tpu") == []
+
+
+@pytest.mark.slow
+def test_mesh_scaling_leg_rows_are_cpu_non_anchor():
+    """bench --measurement-pass's mesh_scaling leg end to end on the virtual
+    mesh: one fixed global batch at 1/2/4/8 devices, every CPU row marked
+    non-anchor, and D>1 rows carrying the device-count reason on top."""
+    import types
+
+    import bench as bench_mod
+
+    args = types.SimpleNamespace(mesh_preset="config1", repeats=1)
+    leg = bench_mod._mesh_scaling_leg(args, True, "cpu")
+    assert set(leg["rows"]) == {"1dev", "2dev", "4dev", "8dev"}
+    for row in leg["rows"].values():
+        assert row["anchor"] is False
+        assert any("CPU run" in r for r in row["non_anchor_reasons"])
+    assert any("single-device roofline" in r
+               for r in leg["rows"]["8dev"]["non_anchor_reasons"])
+    assert not any("single-device roofline" in r
+                   for r in leg["rows"]["1dev"]["non_anchor_reasons"])
+    assert leg["speedup_vs_1dev"]["1dev"] == 1.0
+
+
+@pytest.mark.slow
+def test_windowed_sharded_genome_values_do_not_recompile():
+    """New genome VALUES reuse the compiled program (the scenario-engine
+    contract, extended to the sharded evaluator)."""
+    from raft_sim_tpu.scenario import genome as gm
+    from raft_sim_tpu.scenario import search as sm
+
+    tcfg = dataclasses.replace(CFG, track_trace=True)
+    from raft_sim_tpu.trace.ring import TraceSpec
+
+    ts = TraceSpec(depth=8, coverage=True)
+    knobs = sm.default_knobs(tcfg)
+    rng = np.random.default_rng(0)
+    mk = lambda: gm.stack_rows(
+        [sm.decode_row(tcfg, knobs, x) for x in rng.random((8, len(knobs)))]
+    )
+    mesh = make_mesh(8)
+    mesh_mod.simulate_windowed_sharded(tcfg, 5, 8, 64, 32, mesh,
+                                       genome=mk(), trace=ts)
+    n0 = mesh_mod.simulate_windowed_sharded._cache_size()
+    mesh_mod.simulate_windowed_sharded(tcfg, 6, 8, 64, 32, mesh,
+                                       genome=mk(), trace=ts)
+    assert mesh_mod.simulate_windowed_sharded._cache_size() == n0

@@ -26,6 +26,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _JAX_TOO_OLD = tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5)
 
 
+def test_multichip_artifact_schema():
+    """The standing MULTICHIP row must validate (multichip-v2: throughput,
+    per-device bytes, parity hash) and the historical rc-only stubs must be
+    reported as legacy, not silently passed."""
+    from raft_sim_tpu.utils.telemetry_sink import validate_multichip
+
+    assert validate_multichip(os.path.join(REPO, "MULTICHIP_r06.json")) == []
+    errs = validate_multichip(os.path.join(REPO, "MULTICHIP_r01.json"))
+    assert errs and "legacy" in errs[0], errs
+
+
 @pytest.mark.skipif(
     _JAX_TOO_OLD,
     reason="jax<0.5 CPU backend: 'Multiprocess computations aren't implemented'",
