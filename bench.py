@@ -68,6 +68,7 @@ MATRIX_TICKS = {
 }
 SMOKE_BATCH = {
     "config2": 64,
+    "config8": 64,
     "config9": 64,
     "config3": 512,
     "config3p": 512,
@@ -552,6 +553,11 @@ def measurement_pass(args) -> int:
                          legs live but no traffic) -- prices the serve-mode
                          carry traffic_audit --serve projects.
 
+    Plus the transfer-during-joint interaction pair on config8 (ROADMAP
+    item 4's named follow-up): homogeneous preset cadences vs a genome that
+    forces TimeoutNow transfers into nearly every joint-consensus window;
+    both rows reconcile in the standing table, marked scenario/non-anchor.
+
     On a CPU image the pass auto-shrinks to --smoke sizing (CPU rows can
     never anchor anyway -- reconciliation marks every row non-anchor);
     --full forces production sizing on any backend.
@@ -668,12 +674,60 @@ def measurement_pass(args) -> int:
             "notes": ["skipped: --configs dropped config5 and/or config5c"],
         }
 
+    # Transfer-during-joint interaction rows (ROADMAP item 4's named
+    # follow-up): config8's preset cadences (a membership toggle every 97
+    # ticks, a TimeoutNow transfer every 61) overlap a joint-consensus
+    # window only occasionally, so the standing rows never price the
+    # CONTENDED case -- a transfer in flight during a dual-quorum joint
+    # phase (transfer lease refusing client commands + dual majorities +
+    # the removed-leader stepdown, all live at once). Both arms run the
+    # scenario path so the ratio prices the cadence interaction, not the
+    # genome-table reads: the baseline is config8's own homogeneous genome,
+    # the interaction arm forces the overlap (toggle every 24 ticks opens
+    # joint windows back to back, transfers fire every 5 so nearly every
+    # joint phase carries one; faults at config8's own levels).
+    print("measurement A/B transfer-during-joint (config8)...", file=sys.stderr)
+    xj_cfg = PRESETS["config8"][0]
+    xj_batch, xj_ticks = _matrix_sizing("config8", smoke)
+    xj_plain = bench(
+        xj_cfg, xj_batch, xj_ticks, args.repeats, config_name="config8",
+        smoke=smoke,
+        scenario=SimpleNamespace(
+            genome=genome_mod.from_config(xj_cfg), seg_len=1,
+            name="homogeneous-from-config",
+        ),
+    )
+    xj_on = bench(
+        xj_cfg, xj_batch, xj_ticks, args.repeats, config_name="config8",
+        smoke=smoke,
+        scenario=SimpleNamespace(
+            genome=genome_mod.from_segments([genome_mod.segment(
+                drop_prob=xj_cfg.drop_prob,
+                crash_prob=xj_cfg.crash_prob,
+                crash_down_ticks=xj_cfg.crash_down_ticks,
+                client_interval=xj_cfg.client_interval,
+                reconfig_interval=24,
+                transfer_interval=5,
+                read_interval=xj_cfg.read_interval,
+            )]), seg_len=1, name="xfer-joint",
+        ),
+    )
+
     mesh_scaling = _mesh_scaling_leg(args, smoke, backend)
 
     from raft_sim_tpu.obs import reconcile_matrix
 
-    reconciliation = reconcile_matrix({"matrix": matrix},
-                                      default_backend=backend)
+    # The interaction rows reconcile like every standing row (same table,
+    # same anchor guards): both carry `scenario`, so neither can ever
+    # rebase config8's roofline -- the reconciliation simply reports them.
+    reconciliation = reconcile_matrix(
+        {"matrix": {
+            **matrix,
+            "config8": xj_plain,
+            "config8/xfer-joint": xj_on,
+        }},
+        default_backend=backend,
+    )
     trajectory, traj_notes = _bench_trajectory()
 
     doc = {
@@ -701,6 +755,18 @@ def measurement_pass(args) -> int:
                  "(traffic_audit --serve has the static projection)"],
             ),
             "layout_dense_vs_compact": layout_ab,
+            "transfer_during_joint": _ab_pair(
+                "config8: homogeneous cadences (reconfig@97/transfer@61) vs "
+                "forced transfer-during-joint overlap (reconfig@24/"
+                "transfer@5)",
+                xj_plain, xj_on,
+                ["both arms ride the scenario input path, so the ratio "
+                 "prices the joint-phase/transfer contention itself "
+                 "(dual-quorum counting + transfer lease + stepdown), not "
+                 "the genome-table reads",
+                 "scenario rows: neither arm can anchor config8's roofline "
+                 "(obs/reconcile marks both non-anchor)"],
+            ),
         },
         "mesh_scaling": mesh_scaling,
         "reconciliation": reconciliation,

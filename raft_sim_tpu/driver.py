@@ -87,6 +87,9 @@ class Session:
         self._trace_spec = None  # trace.TraceSpec (attach_trace)
         self._trace_persist = None  # cross-chunk trace carry (batch-minor)
         self._trace_trigger = None  # flight-recorder event-kind trigger
+        self.health = None  # health.HealthMonitor (attach_health)
+        self._health_args = None  # (spec, directory) for reset re-attach
+        self._live_rec = None  # this chunk's recorder (health evidence hook)
         self.reset()
 
     def reset(self) -> None:
@@ -122,6 +125,12 @@ class Session:
             self._trace_persist = None
             if self.telemetry is not None:
                 self.telemetry.write_trace_meta(spec)
+        # ... and a fresh health plane: re-attaching truncates health.jsonl /
+        # alerts.jsonl and clears stale evidence dirs, and the burn-rate state
+        # machines restart from ok (a rebuilt experiment's budget is fresh).
+        self._live_rec = None
+        if self._health_args is not None:
+            self.attach_health(*self._health_args)
 
     def _apply_sharding(self) -> None:
         if self.devices is None:
@@ -243,6 +252,56 @@ class Session:
         self.perf = ChunkTimer(
             label="run", batch=self.batch, sink=self.telemetry, **kwargs
         )
+        if self.health is not None:
+            # Either attach order works: an already-armed monitor picks up
+            # the new timer for its runtime SLIs (device-wait, recompiles).
+            self.health.perf = self.perf
+
+    def attach_health(self, spec="default", directory: str | None = None) -> None:
+        """Arm the fleet health plane (raft_sim_tpu/health; docs/OBSERVABILITY.md
+        "Fleet health & SLOs"): run() evaluates the SLO spec every
+        `eval_windows` telemetry windows (or chunks, on the plain path) and
+        streams health.jsonl + alerts.jsonl into the attached telemetry
+        sink's directory -- or an explicit `directory` when no sink is
+        attached (the plain chunked path). Firing burn-rate alerts triage
+        the worst clusters and freeze an evidence bundle; with the flight
+        recorder armed (attach_telemetry ring>0) the named clusters' live
+        rings are snapshotted into it. Purely host-side: the monitor reads
+        only host copies the loop already fetched, so instrumented runs are
+        bit-exact vs plain (tier-1 pinned, tests/test_health.py)."""
+        from raft_sim_tpu.health import HealthMonitor, HealthWriter, load_spec
+
+        target = directory or (
+            self.telemetry.directory if self.telemetry is not None else None
+        )
+        if target is None:
+            raise RuntimeError(
+                "attach_health needs somewhere to stream health.jsonl: "
+                "attach a telemetry sink first (attach_telemetry) or pass "
+                "directory="
+            )
+        self._health_args = (spec, directory)
+        self.health = HealthMonitor(
+            load_spec(spec) if not isinstance(spec, dict) else spec,
+            batch=self.batch, writer=HealthWriter(target), scope="fleet",
+            perf=self.perf, capture=self._health_capture,
+        )
+
+    def _health_capture(self, alert, clusters):
+        """Evidence hook for the session's monitor: snapshot the triaged
+        clusters' live flight-recorder rings (telemetry path with ring>0;
+        the plain path has no recorder and contributes refs only)."""
+        flights = {}
+        rec = self._live_rec if self._live_rec is not None else self._tel_rec
+        if rec is not None:
+            from raft_sim_tpu.sim import telemetry
+
+            for c in clusters:
+                flights[int(c)] = telemetry.export_cluster(rec, int(c))
+        return {
+            "flights": flights,
+            "refs": {"seed": self.seed, "batch": self.batch, "source": "run"},
+        }
 
     def run(self, n_ticks: int, chunk: int = 4096, progress: bool = False) -> None:
         def progress_line(done, metrics):
@@ -255,10 +314,22 @@ class Session:
 
             def cb_t(done, state, metrics, records):
                 self.telemetry.append_windows(records)
+                if self.health is not None:
+                    # After the sink append: the monitor reads the same host
+                    # copy the export path fetched, never its own device_get.
+                    self.health.observe_records(records)
                 if self.apply_writer is not None:
                     self.apply_writer.update(state)
                 progress_line(done, metrics)
                 return False
+
+            # The health evidence hook needs THIS chunk's carried recorder
+            # (a firing alert snapshots the named clusters' live rings);
+            # chunk_hook runs before cb_t, so the stash is always current.
+            hook = None
+            if self.health is not None:
+                def hook(done, rec):
+                    self._live_rec = rec
 
             if self._trace_spec is not None or self._trace_trigger is not None:
                 out = telemetry.run_chunked_telemetry(
@@ -270,6 +341,7 @@ class Session:
                     trigger_kind=self._trace_trigger,
                     trace_callback=lambda done, traws:
                         self.telemetry.append_trace(traws),
+                    chunk_hook=hook,
                 )
                 if self._trace_spec is not None:
                     self.state, m, self._tel_rec, self._trace_persist = out
@@ -280,15 +352,25 @@ class Session:
                     self.cfg, self.state, self.keys, n_ticks,
                     window=self.telemetry.window, recorder=self._tel_rec,
                     chunk=chunk, callback=cb_t, perf=self.perf,
+                    chunk_hook=hook,
                 )
             self.metrics = chunked.merge_metrics(self.metrics, m)
             return
 
         def cb(done, state, metrics):
+            if self.health is not None:
+                # Plain path: the chunk is the window (observe_chunk derives
+                # per-chunk counter deltas from the cumulative RunMetrics).
+                self.health.observe_chunk(done, metrics)
             if self.apply_writer is not None:
                 self.apply_writer.update(state)
             progress_line(done, metrics)
             return False
+
+        if self.health is not None:
+            # run_chunked restarts its cumulative metrics and tick counter
+            # per call: re-baseline the monitor's delta accumulator.
+            self.health.begin_run()
 
         self.state, m = chunked.run_chunked(
             self.cfg, self.state, self.keys, n_ticks, chunk=chunk, callback=cb,
@@ -552,6 +634,9 @@ class Session:
         self._trace_spec = None
         self._trace_persist = None
         self._trace_trigger = None
+        self.health = None
+        self._health_args = None
+        self._live_rec = None
         self.cfg = cfg
         self.batch = state.role.shape[0]
         self.seed = seed
@@ -849,6 +934,7 @@ def _scenario_farm(args, ap) -> int:
             res = run_farm(
                 cfg, spec, mutant=mutant, out_dir=args.out_dir,
                 corpus_dir=args.corpus_dir, freeze=args.freeze, mesh=mesh,
+                health=args.health,
             )
     except ValueError as ex:
         ap.error(str(ex))
@@ -966,11 +1052,15 @@ def _serve(args, ap) -> int:
                        reads=args.reads_per_tenant)
                 for i in range(n_ten)
             ]
+    if args.health and not args.sink:
+        ap.error("--health needs --sink (the health/alert streams ride the "
+                 "telemetry sink directory)")
     try:
         sess = ServeSession(
             cfg, batch=batch, seed=args.seed or 0, chunk=args.chunk,
             window=args.window, delta_depth=args.delta_depth, sink=sink,
             warmup_ticks=args.warmup, perf=perf, tenants=tenants,
+            health=args.health,
         )
     except ValueError as ex:
         ap.error(str(ex))
@@ -1099,6 +1189,18 @@ def main(argv=None) -> int:
                             "--telemetry-dir when given, and prints the "
                             "steady-state rollup either way. Host-side only: "
                             "trajectories and lowerings are untouched")
+    run_p.add_argument("--health", nargs="?", const="default", default=None,
+                       metavar="SPEC",
+                       help="arm the fleet health plane (raft_sim_tpu/health; "
+                            "requires --telemetry-dir): evaluate the SLO spec "
+                            "(omit SPEC for the built-in default, or give a "
+                            "JSON spec file) every eval period, streaming "
+                            "health.jsonl + alerts.jsonl into the sink; "
+                            "firing burn-rate alerts triage worst clusters "
+                            "and freeze evidence bundles with live "
+                            "flight-ring snapshots. Host-side only: "
+                            "trajectories stay bit-exact vs an unmonitored "
+                            "run")
     _add_config_flags(run_p)
 
     sub.add_parser("presets", help="list the BASELINE config presets")
@@ -1158,6 +1260,15 @@ def main(argv=None) -> int:
                               "loop (dispatch / ingest-pack host gap / "
                               "device wait; jit-cache watchdog); streams "
                               "perf.jsonl into --sink when given")
+    serve_p.add_argument("--health", nargs="?", const="default", default=None,
+                         metavar="SPEC",
+                         help="arm fleet + per-tenant health monitoring "
+                              "(raft_sim_tpu/health; requires --sink): one "
+                              "SLO evaluator per scope streams health.jsonl "
+                              "+ alerts.jsonl, prints live status "
+                              "transitions, and freezes evidence bundles on "
+                              "firing burn-rate alerts. Omit SPEC for the "
+                              "built-in default, or give a JSON spec file")
     serve_p.add_argument("--profile", metavar="DIR", default=None,
                          help="capture a jax.profiler trace of the serving "
                               "session into DIR (view with tensorboard/"
@@ -1295,6 +1406,14 @@ def main(argv=None) -> int:
     sfarm.add_argument("--freeze", action="store_true",
                        help="let the farm WRITE new checker-gated, "
                             "provenance-stamped artifacts into --corpus-dir")
+    sfarm.add_argument("--health", nargs="?", const="default", default=None,
+                       metavar="SPEC",
+                       help="arm health monitoring over the hunt fleet "
+                            "(raft_sim_tpu/health): each generation's window "
+                            "records feed the SLO evaluator, streaming "
+                            "health.jsonl + alerts.jsonl into --out-dir "
+                            "(safety alerts fire immediately on a violating "
+                            "generation). Omit SPEC for the default spec")
     sfarm.add_argument("--backend", default="auto", metavar="NAME")
     sfarm.add_argument("--profile", metavar="DIR", default=None,
                        help="capture a jax.profiler trace of the farm into "
@@ -1391,10 +1510,10 @@ def main(argv=None) -> int:
 
     if args.trace_ticks or args.trace_events:
         if (args.save or args.profile or args.apply_log or args.telemetry_dir
-                or args.perf):
-            ap.error("--save/--profile/--apply-log/--telemetry-dir/--perf "
-                     "have no effect with --trace-ticks/--trace-events "
-                     "(tracing does not advance the session)")
+                or args.perf or args.health):
+            ap.error("--save/--profile/--apply-log/--telemetry-dir/--perf/"
+                     "--health have no effect with --trace-ticks/"
+                     "--trace-events (tracing does not advance the session)")
         n = args.trace_ticks or args.ticks
         infos, states = sess.trace(n, cluster=args.trace_cluster)
         if args.trace_events:
@@ -1439,6 +1558,17 @@ def main(argv=None) -> int:
         # only the steady-state rollup is printed.
         sess.attach_perf()
 
+    if args.health:
+        if not args.telemetry_dir:
+            ap.error("--health needs --telemetry-dir (the health/alert "
+                     "streams ride the telemetry sink directory; the "
+                     "sink-free plain path is the Session.attach_health "
+                     "API's directory= form)")
+        try:
+            sess.attach_health(args.health)
+        except ValueError as ex:
+            ap.error(str(ex))
+
     t0 = time.perf_counter()
     with _profile_ctx(args.profile):
         sess.run(args.ticks, chunk=args.chunk, progress=args.progress)
@@ -1453,6 +1583,11 @@ def main(argv=None) -> int:
         # Steady-state attribution rollup + the recompile-watchdog finding
         # (finish() prints it to stderr if a steady-state chunk compiled).
         out["perf"] = sess.perf.finish()
+    if sess.health is not None:
+        # Trailing partial eval period included; the rollup names every
+        # objective that fired so a scripted run can gate on it.
+        out["health"] = sess.health.finalize()
+        print(sess.health.status_line(), file=sys.stderr)
     print(json.dumps(out))
 
     if args.telemetry_dir:

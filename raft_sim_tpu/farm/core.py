@@ -228,6 +228,7 @@ def run_farm(
     freeze: bool = False,
     perf=None,
     mesh=None,
+    health=None,
 ) -> FarmResult:
     """Run the portfolio hunt. `cfg` must already be the kernel under test
     (mutant_config-applied for mutant hunts; `mutant` labels artifacts and
@@ -246,6 +247,12 @@ def run_farm(
     be dedup-rejected again; the default stop_on="hit" avoids that, and a
     per-run signature memo is the named follow-up if long mutant soaks
     become a workflow.
+
+    `health` (a health SLO spec: "default", a path, or a dict) folds the
+    streaming evaluator into the per-generation record fetch the farm already
+    does -- one scope ("farm") over the whole portfolio population, streams
+    under `out_dir` beside the hunt files. Host-side only: hunts are
+    bit-identical with it armed.
 
     `mesh` (a parallel.make_mesh 1-D cluster mesh) shards each generation's
     evaluation over the devices (parallel.simulate_windowed_sharded):
@@ -338,6 +345,25 @@ def run_farm(
         probe = ("telemetry.simulate_windowed", telemetry.simulate_windowed)
     if perf is not None:
         perf.add_probe(*probe)
+    monitor = None
+    if health is not None:
+        if out_dir is None:
+            raise ValueError(
+                "health monitoring needs an out_dir: the health/alert streams "
+                "and evidence bundles live there"
+            )
+        from raft_sim_tpu.health import HealthMonitor, HealthWriter, load_spec
+
+        refs = {"farm": mhash, "mutant": mutant, "seed": spec.seed}
+        monitor = HealthMonitor(
+            load_spec(health), batch=spec.population,
+            writer=HealthWriter(out_dir), scope="farm", perf=perf,
+            capture=lambda alert, clusters: {"refs": refs},
+        )
+    # perf.jsonl keying for reconciliation (obs/reconcile.py): farm rows are
+    # self-describing about what measured them -- a mesh-sharded generation's
+    # aggregate throughput must never read as a single-device number.
+    run_devices = mesh.devices.size if mesh is not None else 1
 
     gens: list[dict] = []
     hits: list[dict] = []
@@ -378,9 +404,14 @@ def run_farm(
 
         if perf is not None:
             perf.dispatched()
+            perf.annotate(
+                n_devices=run_devices, backend=jax.default_backend(),
+            )
             perf.end(sync=lambda: np.asarray(metrics.ticks))
         metrics = jax.device_get(metrics)
         records = jax.device_get(records)
+        if monitor is not None:
+            monitor.observe_records(records)
         cov = np.asarray(tp.cov) if tp is not None else None
 
         # --- score + CE-update each member against the shared baseline.
@@ -503,6 +534,8 @@ def run_farm(
         "dedup_rejected": dedup_rejected,
         "negative": not hits,
     }
+    if monitor is not None:
+        manifest["health"] = monitor.finalize()
     if sink is not None:
         sink.write_manifest(manifest)
         if not hits:
@@ -619,4 +652,9 @@ def validate_farm_dir(directory: str) -> list[str]:
                             f"perf.jsonl:{ln}: field {k!r} missing or not a "
                             "non-negative number"
                         )
+    # Health streams ride farm out-dirs too (run_farm health=): same schema,
+    # same checker, as a telemetry directory's.
+    from raft_sim_tpu.utils.telemetry_sink import validate_health_files
+
+    errors.extend(validate_health_files(directory))
     return errors

@@ -226,35 +226,80 @@ def _preset_name(config_dict: dict) -> str | None:
 
 
 def reconcile_perf_dir(directory: str, pins: dict | None = None) -> dict:
-    """Reconcile a telemetry directory's perf.jsonl stream: steady-state
-    throughput recomputed from the rows themselves (not trusted from any
-    summary), joined against the manifest config's pins. The manifest's
-    backend decides anchor eligibility -- a CPU perf run reconciles but
-    never anchors."""
+    """Reconcile a directory's perf.jsonl stream: steady-state throughput
+    recomputed from the rows themselves (not trusted from any summary),
+    joined against the directory config's pins. Telemetry directories carry
+    a full manifest.json; farm out-dirs (scenario farm / driver sfarm) carry
+    farm_manifest.json instead -- their identity (config, population) comes
+    from it, and backend/n_devices come from the rows themselves (the farm's
+    timer annotates each generation, so a mesh-sharded hunt's aggregate
+    throughput is keyed non-anchor like any multi-device row). A CPU perf
+    run reconciles but never anchors, either way."""
+    import dataclasses as _dc
+
     from raft_sim_tpu.obs.timer import summarize_rows
     from raft_sim_tpu.utils import telemetry_sink
+    from raft_sim_tpu.utils.config import RaftConfig
 
-    man = telemetry_sink.read_manifest(directory)
     rows = read_perf(directory)
     if not rows:
         raise ValueError(f"{directory}: no perf.jsonl rows to reconcile")
-    batch = int(man.get("batch", 1))
-    summary = summarize_rows(rows, label=man.get("source", "run"), batch=batch)
-    name = _preset_name(man.get("config") or {})
+    farm_path = os.path.join(directory, "farm_manifest.json")
+    if os.path.isfile(os.path.join(directory, "manifest.json")):
+        man = telemetry_sink.read_manifest(directory)
+        batch = int(man.get("batch", 1))
+        label = man.get("source", "run")
+        config_dict = man.get("config") or {}
+        backend = man.get("backend")
+        farm = False
+    elif os.path.isfile(farm_path):
+        with open(farm_path) as f:
+            man = json.load(f)
+        batch = int(man.get("population", 1))
+        label = "farm"
+        # The farm manifest stores only non-default fields (hunt identity);
+        # defaults reconstruct the full config for preset matching.
+        try:
+            config_dict = _dc.asdict(RaftConfig(**(man.get("config") or {})))
+        except (TypeError, AssertionError):
+            config_dict = {}
+        # The mesh is deliberately not part of the farm's hashed identity,
+        # so runtime keying comes from the rows (ChunkTimer annotations).
+        backend = next(
+            (r["backend"] for r in reversed(rows) if r.get("backend")), None
+        )
+        farm = True
+    else:
+        raise ValueError(
+            f"{directory}: neither manifest.json nor farm_manifest.json -- "
+            "not a reconcilable perf directory"
+        )
+    summary = summarize_rows(rows, label=label, batch=batch)
+    name = _preset_name(config_dict)
+    n_devices = max(
+        (r["n_devices"] for r in rows
+         if isinstance(r.get("n_devices"), int)), default=1,
+    )
     pseudo = {
         "steady_ticks_per_s": summary["steady_cluster_ticks_per_s"],
         "batch": batch,
-        "backend": man.get("backend"),
+        "backend": backend,
+        "n_devices": n_devices,
     }
     if pins is None:
         pins = load_pins()
     rec = reconcile_row(
-        name or "custom", pseudo, pins, default_backend=man.get("backend"),
+        name or "custom", pseudo, pins, default_backend=backend,
         observed_live_bytes=summary["live_bytes_peak"],
     )
     if name is None:
         rec["notes"].append(
             "manifest config matches no preset: no pins to join against"
+        )
+    if farm:
+        rec["notes"].append(
+            "farm out-dir: one row per CE generation (whole-portfolio "
+            "evaluations), batch = the portfolio population"
         )
     rec["notes"].append(
         "measured through the chunked loop (per-chunk sync points), not the "

@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import sys
 import time
 
 import jax
@@ -186,6 +187,7 @@ class ServeSession:
         warmup_ticks: int = 0,
         perf=None,
         tenants=None,
+        health=None,
     ):
         if chunk % window:
             raise ValueError(f"chunk {chunk} must divide by window {window}")
@@ -250,6 +252,12 @@ class ServeSession:
         self.chunks_done = 0
         self.ticks_done = 0
         self.warmup_chunks = 0
+        # SLO monitoring (raft_sim_tpu/health): armed AFTER warmup below, so
+        # election convergence is never billed against the availability
+        # budget -- the same exclusion the perf warmup_chunks bump applies.
+        self.monitors: list = []
+        self._health_spec = health
+        self._health_status: tuple | None = None
         if warmup_ticks:
             # Elect leaders before the first real offer plane (an offer into a
             # leaderless tick is dropped, exactly like the reference's curl
@@ -258,6 +266,38 @@ class ServeSession:
             self._advance(self._round_up(warmup_ticks))
             self.warmup_chunks, self.chunks_done = self.chunks_done, 0
             self.ticks_done = 0
+        if health is not None:
+            if sink is None:
+                raise ValueError(
+                    "health monitoring needs a sink: the health/alert streams "
+                    "and evidence bundles live in its directory"
+                )
+            from raft_sim_tpu.health import HealthMonitor, HealthWriter, load_spec
+            from raft_sim_tpu.utils.telemetry_sink import config_hash
+
+            spec = load_spec(health)
+            writer = HealthWriter(sink.directory)
+            refs = {
+                "config_hash": config_hash(self.cfg),
+                "seed": int(seed),
+                "batch": int(batch),
+                "source": "serve",
+            }
+            capture = lambda alert, clusters: {"refs": refs}
+            # One fleet monitor (it owns the runtime SLIs: the perf rows are
+            # loop-wide) + one per tenant slice when the session is
+            # multi-tenant -- all sharing one writer, scope-tagged lines.
+            self.monitors.append(HealthMonitor(
+                spec, batch=batch, writer=writer, scope="fleet",
+                perf=perf, capture=capture,
+            ))
+            if self.router is not None:
+                for t in self.router.tenants:
+                    self.monitors.append(HealthMonitor(
+                        spec, batch=t.hi - t.lo, writer=writer,
+                        scope=f"tenant:{t.name}", cluster_base=t.lo,
+                        capture=capture,
+                    ))
 
     def _round_up(self, ticks: int) -> int:
         return -(-ticks // self.chunk) * self.chunk
@@ -310,11 +350,36 @@ class ServeSession:
                 self.sink.append_windows(recs)
             if self.router is not None:
                 self.router.credit_windows(recs)
+            if self.monitors:
+                self._observe_health(recs)
         self.delta_rows.extend(rows)
         if self.sink is not None and rows:
             deltas_mod.append_delta_rows(self._deltas_path, rows)
         if self.router is not None and rows:
             self.router.route_deltas(rows)
+
+    def _observe_health(self, recs) -> None:
+        """Fan one collected chunk's window units to the fleet + tenant
+        monitors (units split once, tenant views are numpy slices) and print
+        the live status line to stderr whenever any scope changes state."""
+        from raft_sim_tpu.health.monitor import slice_units
+        from raft_sim_tpu.sim import telemetry
+
+        units = telemetry.window_cluster_counters(recs)
+        for m in self.monitors:
+            if m.cluster_base == 0 and m.batch == self.batch:
+                m.observe_units(units)
+            else:
+                m.observe_units(
+                    slice_units(units, m.cluster_base, m.cluster_base + m.batch)
+                )
+        status = tuple(m.status for m in self.monitors)
+        if self._health_status is not None and status != self._health_status:
+            print(
+                "; ".join(m.status_line() for m in self.monitors),
+                file=sys.stderr,
+            )
+        self._health_status = status
 
     def _collect(self) -> list[dict]:
         """Synchronous collect (warmup / single-step use): merge the
@@ -446,6 +511,10 @@ class ServeSession:
         if self.perf is not None:
             # Steady-state rollup + the recompile-watchdog finding (stderr).
             stats["perf"] = self.perf.finish()
+        if self.monitors:
+            # Evaluate any partial trailing period, then replace the live
+            # status map with each scope's full rollup for summary.json.
+            stats["health"] = [m.finalize() for m in self.monitors]
         if self.sink is not None:
             from raft_sim_tpu.parallel import summarize
 
@@ -479,6 +548,11 @@ class ServeSession:
             # clock, not the service's unit of work.
             "ops_done": self.deltas.applied + reads_served,
             "violations": int(np.sum(np.asarray(self.metrics.violations))),
+            **(
+                {"health": {m.scope: m.status for m in self.monitors}}
+                if self.monitors
+                else {}
+            ),
         }
 
     def acked_values(self, cluster: int = 0) -> list[int]:

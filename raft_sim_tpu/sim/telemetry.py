@@ -377,10 +377,17 @@ def run_chunked_telemetry(
     trace_persist=None,
     trigger_kind: int | None = None,
     trace_callback=None,
+    chunk_hook=None,
 ):
     """Long-horizon telemetry runs: the `chunked.run_chunked` analogue with
     window records offloaded to the host between chunks (so a 10M-tick soak
     holds at most chunk/window records on device at once).
+
+    `chunk_hook(ticks_done, recorder)` is a host-side observer handed the
+    CARRIED flight recorder (batch-minor) after each chunk -- the health
+    plane's evidence hook: a firing alert snapshots the named clusters' rings
+    via `export_cluster` without freezing or perturbing the device carry.
+    Read-only by contract; it cannot return a replacement.
 
     `perf` (an obs.ChunkTimer) attributes each chunk's wall time and samples
     the soak program's jit cache at chunk boundaries (recompile watchdog),
@@ -453,6 +460,8 @@ def run_chunked_telemetry(
         # this chunk's host gap; close after it, synced on the chunk metrics.
         if traws is not None and trace_callback is not None:
             trace_callback(done, traws)
+        if chunk_hook is not None:
+            chunk_hook(done, recorder)
         stop = callback is not None and callback(done, state, metrics, recs)
         if perf is not None:
             perf.end(sync=lambda: np.asarray(m.ticks))
@@ -473,6 +482,40 @@ def reduce_records(records: WindowRecord) -> scan.RunMetrics:
     for w in range(1, n_windows):
         m = merge_metrics(m, take(w))
     return m
+
+
+def window_cluster_counters(records: WindowRecord) -> list[dict]:
+    """Split a stacked WindowRecord (public layout: leaves [B, n_windows, ...])
+    into one host-side dict of per-cluster numpy counters PER WINDOW -- the
+    health plane's window units (health/sli.py consumes them; health/evidence
+    freezes them per culprit cluster). `leaderless` marks clusters whose
+    window-local first_leader_tick never latched: no tick in that window
+    observed a leader, the availability = 1 - leaderless-fraction signal.
+    Read-only host math over an already-fetched record -- the sink path calls
+    this on the same host copy it aggregates into windows.jsonl lines."""
+    start = np.asarray(records.start)
+    n_windows = start.shape[1]
+    m = {
+        f: np.asarray(getattr(records.metrics, f))
+        for f in ("ticks", "violations", "first_leader_tick", "total_cmds",
+                  "reads_served", "lat_sum", "lat_cnt", "lat_hist",
+                  "read_hist")
+    }
+    units = []
+    for w in range(n_windows):
+        units.append({
+            "start": int(start[0, w]),
+            "ticks": int(m["ticks"][0, w]),
+            "violations": m["violations"][:, w].astype(np.int64),
+            "leaderless": m["first_leader_tick"][:, w] == NEVER,
+            "cmds": m["total_cmds"][:, w].astype(np.int64),
+            "reads": m["reads_served"][:, w].astype(np.int64),
+            "lat_sum": m["lat_sum"][:, w].astype(np.int64),
+            "lat_cnt": m["lat_cnt"][:, w].astype(np.int64),
+            "lat_hist": m["lat_hist"][:, w].astype(np.int64),
+            "read_hist": m["read_hist"][:, w].astype(np.int64),
+        })
+    return units
 
 
 def export_cluster(recorder: FlightRecorder, cluster: int):

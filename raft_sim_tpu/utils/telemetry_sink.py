@@ -43,6 +43,18 @@ shared shape. This module is the one schema all of them write now:
                                (wall-clock measurements, not simulation
                                state), so it is exempt from the integer-exact
                                rule below.
+    <dir>/health.jsonl         OPTIONAL (--health): one line per SLO
+                               evaluation period per scope (fleet/tenant) --
+                               SLI values, per-rule burn rates, worst state
+                               (raft_sim_tpu/health monitor.py). Floats
+                               allowed, same exemption as perf.jsonl.
+    <dir>/alerts.jsonl         OPTIONAL: one line per burn-rate alert
+                               TRANSITION (pending/firing/resolved/ok) with
+                               the triaged worst-K clusters and, on firing,
+                               the evidence_NNNN bundle it froze.
+    <dir>/evidence_NNNN/       OPTIONAL: per-firing-alert forensics bundle
+                               (health/evidence.py: alert.json + per-culprit
+                               window rows, perf rows, flight snapshots).
 
 Everything is line-delimited JSON with integer-exact values (no floats in the
 window stream), so two runs diff textually and `validate()` can check the
@@ -104,6 +116,15 @@ WINDOW_FIELDS = (
 PERF_INT_FIELDS = ("chunk", "ticks")
 PERF_BOOL_FIELDS = ("warmup", "recompiled")
 PERF_FLOAT_FIELDS = ("wall_s", "dispatch_s", "host_s", "device_wait_s", "gap_s")
+
+# Per-line required fields of health.jsonl / alerts.jsonl (health/monitor.py).
+# `eval` indices are contiguous PER SCOPE (serve streams fleet + per-tenant
+# monitors into the same files); status/state values are the burn-engine
+# lifecycle words.
+HEALTH_INT_FIELDS = ("eval", "window_start", "windows", "ticks")
+HEALTH_STATUSES = ("ok", "pending", "firing")
+ALERT_FLOAT_FIELDS = ("burn_short", "burn_long")
+ALERT_STATES = ("ok", "pending", "firing", "resolved")
 
 MANIFEST_FIELDS = (
     "schema_version",
@@ -171,6 +192,25 @@ def window_lines(records, first_index: int) -> list[dict]:
     return lines
 
 
+def flight_lines(ticks, infos: StepInfo) -> list[dict]:
+    """One cluster's flight-recorder export (telemetry.export_cluster output)
+    as line dicts: one per captured tick, every StepInfo field. THE one
+    flight serialization -- the sink's violation flights and the health
+    plane's evidence snapshots (health/evidence.py) both call it, so the two
+    file families stay renderable by the same metrics_report path."""
+    fields = {f: np.asarray(getattr(infos, f)) for f in infos._fields}
+    lines = []
+    for i, t in enumerate(np.asarray(ticks)):
+        row = {"tick": int(t)}
+        for name, arr in fields.items():
+            v = arr[i]
+            row[name] = (
+                [int(x) for x in v] if v.ndim else (int(v) if v.dtype != bool else bool(v))
+            )
+        lines.append(row)
+    return lines
+
+
 def config_hash(cfg: RaftConfig) -> str:
     """Stable short hash of the full config (key-sorted JSON), the manifest's
     comparability key: two runs diff cleanly iff their hashes match."""
@@ -223,15 +263,21 @@ class TelemetrySink:
         open(self._path("windows.jsonl"), "w").close()  # truncate the stream
         self._n_trace_windows = 0
         # A rebuilt run must not inherit the previous run's violation
-        # recordings, rollup, or perf/trace streams: stale files under a
-        # fresh manifest would misattribute another run's data to this one.
-        # (perf.jsonl / trace*.jsonl are only re-created when armed.)
+        # recordings, rollup, or perf/trace/health streams: stale files under
+        # a fresh manifest would misattribute another run's data to this one.
+        # (perf/trace/health files are only re-created when armed.)
+        import shutil
+
         for name in os.listdir(directory):
-            if (name.startswith("flight_") and name.endswith(".jsonl")) or (
+            p = os.path.join(directory, name)
+            if name.startswith("evidence_") and os.path.isdir(p):
+                shutil.rmtree(p)
+            elif (name.startswith("flight_") and name.endswith(".jsonl")) or (
                 name in ("summary.json", "perf.jsonl", "trace.jsonl",
-                         "trace_windows.jsonl", "trace_meta.json")
+                         "trace_windows.jsonl", "trace_meta.json",
+                         "health.jsonl", "alerts.jsonl")
             ):
-                os.remove(os.path.join(directory, name))
+                os.remove(p)
 
     def _path(self, name: str) -> str:
         return os.path.join(self.directory, name)
@@ -335,15 +381,8 @@ class TelemetrySink:
         output) as flight_<cluster>.jsonl: one line per captured tick carrying
         every StepInfo field. Returns the path written."""
         path = self._path(f"flight_{cluster}.jsonl")
-        fields = {f: np.asarray(getattr(infos, f)) for f in infos._fields}
         with open(path, "w") as f:
-            for i, t in enumerate(np.asarray(ticks)):
-                row = {"tick": int(t)}
-                for name, arr in fields.items():
-                    v = arr[i]
-                    row[name] = (
-                        [int(x) for x in v] if v.ndim else (int(v) if v.dtype != bool else bool(v))
-                    )
+            for row in flight_lines(ticks, infos):
                 f.write(json.dumps(row) + "\n")
         return path
 
@@ -571,6 +610,117 @@ def validate(directory: str) -> list[str]:
                 missing = [k for k in ("tick", *StepInfo._fields) if k not in row]
                 if missing:
                     errors.append(f"{name}:{ln}: missing fields {missing}")
+    errors.extend(validate_health_files(directory))
+    return errors
+
+
+def validate_health_files(directory: str) -> list[str]:
+    """Schema-check a directory's health.jsonl / alerts.jsonl / evidence
+    bundles ([] = valid, including when none are present). Split out of
+    validate() so farm out-dirs -- which carry the farm manifest schema, not
+    a telemetry manifest -- check their health streams through the same
+    code (farm/core.py validate_farm_dir)."""
+    errors = []
+    health_path = os.path.join(directory, "health.jsonl")
+    alerts_path = os.path.join(directory, "alerts.jsonl")
+    evidence_named: list[str] = []
+    if os.path.isfile(health_path):
+        if not os.path.isfile(alerts_path):
+            errors.append("health.jsonl present but alerts.jsonl missing")
+        prev_eval: dict[str, int] = {}
+        with open(health_path) as f:
+            for ln, raw in enumerate(f, 1):
+                try:
+                    row = json.loads(raw)
+                except json.JSONDecodeError as ex:
+                    errors.append(f"health.jsonl:{ln}: not JSON: {ex}")
+                    continue
+                for k in HEALTH_INT_FIELDS:
+                    if not isinstance(row.get(k), int) or row.get(k) is True:
+                        errors.append(
+                            f"health.jsonl:{ln}: field {k!r} missing or non-int"
+                        )
+                scope = row.get("scope")
+                if not isinstance(scope, str) or not scope:
+                    errors.append(f"health.jsonl:{ln}: scope missing")
+                    scope = "?"
+                if row.get("status") not in HEALTH_STATUSES:
+                    errors.append(
+                        f"health.jsonl:{ln}: status {row.get('status')!r} "
+                        f"(have: {', '.join(HEALTH_STATUSES)})"
+                    )
+                for k in ("slis", "burn"):
+                    if not isinstance(row.get(k), dict):
+                        errors.append(f"health.jsonl:{ln}: {k} must be a map")
+                if isinstance(row.get("eval"), int):
+                    want = prev_eval.get(scope, -1) + 1
+                    if row["eval"] != want:
+                        errors.append(
+                            f"health.jsonl:{ln}: scope {scope!r} eval "
+                            f"{row['eval']} (expected {want})"
+                        )
+                    prev_eval[scope] = row["eval"]
+    if os.path.isfile(alerts_path):
+        with open(alerts_path) as f:
+            for ln, raw in enumerate(f, 1):
+                try:
+                    row = json.loads(raw)
+                except json.JSONDecodeError as ex:
+                    errors.append(f"alerts.jsonl:{ln}: not JSON: {ex}")
+                    continue
+                if not isinstance(row.get("eval"), int) or row.get("eval") is True:
+                    errors.append(f"alerts.jsonl:{ln}: field 'eval' missing or non-int")
+                for k in ("scope", "objective", "rule"):
+                    if not isinstance(row.get(k), str) or not row.get(k):
+                        errors.append(f"alerts.jsonl:{ln}: field {k!r} missing")
+                if row.get("state") not in ALERT_STATES:
+                    errors.append(
+                        f"alerts.jsonl:{ln}: state {row.get('state')!r} "
+                        f"(have: {', '.join(ALERT_STATES)})"
+                    )
+                for k in ALERT_FLOAT_FIELDS:
+                    v = row.get(k)
+                    if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+                        errors.append(
+                            f"alerts.jsonl:{ln}: field {k!r} missing or not a "
+                            "non-negative number"
+                        )
+                wc = row.get("worst_clusters")
+                if not isinstance(wc, list) or not all(
+                    isinstance(w, dict) and isinstance(w.get("cluster"), int)
+                    for w in wc
+                ):
+                    errors.append(
+                        f"alerts.jsonl:{ln}: worst_clusters must be a list of "
+                        "{cluster, value, score} maps"
+                    )
+                ev = row.get("evidence")
+                if ev is not None:
+                    if not isinstance(ev, str):
+                        errors.append(
+                            f"alerts.jsonl:{ln}: evidence must be a dir name or null"
+                        )
+                    else:
+                        evidence_named.append(ev)
+                        if not os.path.isdir(os.path.join(directory, ev)):
+                            errors.append(
+                                f"alerts.jsonl:{ln}: evidence dir {ev} missing"
+                            )
+                if row.get("state") == "firing" and ev is None:
+                    errors.append(
+                        f"alerts.jsonl:{ln}: firing alert carries no evidence"
+                    )
+    for name in sorted(os.listdir(directory)):
+        if name.startswith("evidence_") and os.path.isdir(
+            os.path.join(directory, name)
+        ):
+            from raft_sim_tpu.health.evidence import validate_bundle
+
+            errors.extend(validate_bundle(os.path.join(directory, name)))
+            if name not in evidence_named:
+                errors.append(
+                    f"{name}: evidence bundle not named by any alerts.jsonl row"
+                )
     return errors
 
 

@@ -347,7 +347,7 @@ def report_measurement(path: str, out=None) -> None:
     print("\n  A/B deltas:", file=out)
     ab = doc.get("ab") or {}
     for key in ("fault_lattice", "serve_offer_plane",
-                "layout_dense_vs_compact"):
+                "layout_dense_vs_compact", "transfer_during_joint"):
         arm = ab.get(key) or {}
         ratio = arm.get("on_over_off_ticks_per_s")
         print(f"  {key:18} on/off throughput ratio: {_fmt(ratio)} "
@@ -382,6 +382,146 @@ def report_measurement(path: str, out=None) -> None:
               file=out)
     for note in doc.get("notes", []):
         print(f"  note: {note}", file=out)
+
+
+def report_health(directory: str, out=None) -> None:
+    """Render a directory's health plane (health.jsonl + alerts.jsonl +
+    evidence bundles -- raft_sim_tpu/health, written by any standing loop run
+    with health monitoring armed): per-scope SLI rollups, the burn-rate
+    state-machine history, each alert transition with its triaged worst
+    clusters, and the frozen evidence bundles' inventories."""
+    hpath = os.path.join(directory, "health.jsonl")
+    if not os.path.isfile(hpath):
+        raise SystemExit(
+            f"{directory}: no health.jsonl (arm monitoring with --health on "
+            "run/serve/scenario farm, or Session.attach_health)"
+        )
+    with open(hpath) as f:
+        health = [json.loads(line) for line in f if line.strip()]
+    apath = os.path.join(directory, "alerts.jsonl")
+    alerts = []
+    if os.path.isfile(apath):
+        with open(apath) as f:
+            alerts = [json.loads(line) for line in f if line.strip()]
+
+    scopes: dict[str, list[dict]] = {}
+    for row in health:
+        scopes.setdefault(row["scope"], []).append(row)
+    print(f"health plane: {directory}\n"
+          f"  {len(health)} evals across {len(scopes)} scopes, "
+          f"{len(alerts)} alert transitions", file=out)
+    for scope, rows in scopes.items():
+        last = rows[-1]
+        print(f"\n  scope {scope}: {len(rows)} evals, "
+              f"{sum(r['ticks'] for r in rows)} ticks, "
+              f"last status {last['status'].upper()}", file=out)
+        for k, v in sorted(last.get("slis", {}).items()):
+            # One measurement group per objective: render the group's
+            # key=value pairs on the objective's line.
+            body = " ".join(f"{kk}={_fmt(vv)}" for kk, vv in v.items())
+            print(f"    {k:16} {body}", file=out)
+        burns = last.get("burn") or {}
+        if burns:
+            print(f"    {'objective':>16} {'rule':>8} {'burn short':>12} "
+                  f"{'burn long':>12}", file=out)
+            for obj, by_rule in sorted(burns.items()):
+                for rule, (short, long_) in sorted(by_rule.items()):
+                    print(f"    {obj:>16} {rule:>8} "
+                          f"{_fmt(short):>12} {_fmt(long_):>12}", file=out)
+
+    if alerts:
+        print("\n  alert transitions:", file=out)
+        cols = ("eval", "scope", "objective", "rule", "state",
+                "burn_short", "burn_long")
+        print("  " + " ".join(f"{c:>11}" for c in cols)
+              + "  worst clusters / evidence", file=out)
+        for a in alerts:
+            worst = ",".join(
+                str(w["cluster"]) + ("*" if w.get("outlier") else "")
+                for w in a.get("worst_clusters", [])
+            ) or "-"
+            ev = a.get("evidence") or ""
+            cells = [
+                v if isinstance(v, str) else _fmt(v)
+                for v in (a.get(c) for c in cols)
+            ]
+            print("  " + " ".join(f"{v:>11}" for v in cells)
+                  + f"  {worst}" + (f" -> {ev}" if ev else ""), file=out)
+        print("  (* = robust outlier: modified z-score above the spec "
+              "threshold)", file=out)
+
+    bundles = sorted(
+        d for d in os.listdir(directory)
+        if d.startswith("evidence_")
+        and os.path.isdir(os.path.join(directory, d))
+    )
+    for name in bundles:
+        path = os.path.join(directory, name)
+        with open(os.path.join(path, "alert.json")) as f:
+            doc = json.load(f)
+        al = doc.get("alert") or {}
+        print(f"\n  evidence bundle {name}: "
+              f"{al.get('scope')}/{al.get('objective')}/{al.get('rule')} "
+              f"at eval {al.get('eval')}", file=out)
+        for fname in doc.get("files", []):
+            size = os.path.getsize(os.path.join(path, fname))
+            print(f"    {fname:24} {size:>10,} bytes", file=out)
+        refs = doc.get("refs") or {}
+        if refs:
+            print("    refs: "
+                  + " ".join(f"{k}={v}" for k, v in sorted(refs.items())),
+                  file=out)
+
+
+def report_multichip(paths: list[str], out=None) -> None:
+    """Render MULTICHIP_r*.json artifacts (tools/multihost_check.py --out;
+    schema'd by telemetry_sink.validate_multichip) as a trajectory table:
+    one row per artifact -- parity verdict, shape, sharded vs reference
+    throughput, and the Pass C per-device byte price. Legacy rc-only stubs
+    (pre-multichip-v2) are listed as such, never silently skipped."""
+    cols = ("artifact", "match", "dev x proc", "batch", "ticks",
+            "sharded t/s", "reference t/s", "overhead", "B/tick/dev")
+    print("multichip proof artifacts:", file=out)
+    print("  " + " ".join(f"{c:>14}" for c in cols), file=out)
+    notes = []
+    for path in paths:
+        name = os.path.basename(path)
+        errors = sink.validate_multichip(path)
+        with open(path) as f:
+            doc = json.load(f)
+        if "schema" not in doc:
+            print(f"  {name:>14}" + f"{'(legacy rc-only stub)':>29}"
+                  + f"{_fmt(doc.get('n_devices')) + ' dev':>15}"
+                  + f"{'rc=' + _fmt(doc.get('rc')):>15}", file=out)
+            notes.append(f"{name}: legacy stub -- regenerate with "
+                         "tools/multihost_check.py --out")
+            continue
+        if errors:
+            for e in errors:
+                notes.append(f"INVALID: {e}")
+            continue
+        ratio = (
+            round(doc["reference_ticks_per_s"] / doc["throughput_ticks_per_s"], 3)
+            if doc["throughput_ticks_per_s"] and
+            doc.get("reference_ticks_per_s") else None
+        )
+        vals = (
+            name, "MATCH" if doc["match"] else "MISMATCH",
+            f"{doc['n_devices']}x{doc['n_processes']}", _fmt(doc["batch"]),
+            _fmt(doc["ticks"]), _fmt(doc["throughput_ticks_per_s"]),
+            _fmt(doc.get("reference_ticks_per_s")), _fmt(ratio),
+            _fmt(doc["per_device_bytes_per_tick"]),
+        )
+        print("  " + " ".join(f"{v:>14}" for v in vals), file=out)
+        notes.append(
+            f"{name}: platform={doc['platform']} "
+            f"violations={doc['violations']} "
+            f"parity={doc['parity_hash'][:12]}..."
+            + (" (cpu rows never anchor the roofline)"
+               if doc["platform"] == "cpu" else "")
+        )
+    for n in notes:
+        print(f"  {n}", file=out)
 
 
 def diff(path_a: str, path_b: str, config: str | None, out=None) -> None:
@@ -430,6 +570,18 @@ def main(argv=None) -> int:
                     help="protocol-trace report: per-cluster event timelines "
                          "from trace.jsonl plus the whole-history checker "
                          "verdicts (raft_sim_tpu/trace)")
+    ap.add_argument("--health", action="store_true",
+                    help="health-plane report: per-scope SLI rollups and "
+                         "burn-rate history from health.jsonl, alert "
+                         "transitions with triaged worst clusters from "
+                         "alerts.jsonl, and evidence-bundle inventories "
+                         "(raft_sim_tpu/health; any directory a monitored "
+                         "run streamed into)")
+    ap.add_argument("--multichip", action="store_true",
+                    help="render MULTICHIP_r*.json proof artifacts "
+                         "(tools/multihost_check.py --out) as a trajectory "
+                         "table: parity verdict, sharded vs reference "
+                         "throughput, per-device byte price")
     ap.add_argument("--trace-cluster", type=int, action="append", default=None,
                     metavar="C", help="restrict --trace to cluster C (repeatable)")
     ap.add_argument("--trace-limit", type=int, default=40,
@@ -439,6 +591,25 @@ def main(argv=None) -> int:
                          "Chrome-trace/Perfetto JSON (one track per node, "
                          "events named by kind; open in ui.perfetto.dev)")
     args = ap.parse_args(argv)
+
+    if args.multichip:
+        if not args.paths:
+            ap.error("--multichip needs at least one MULTICHIP_r*.json path")
+        report_multichip(args.paths)
+        return 0
+
+    if args.health:
+        if len(args.paths) != 1:
+            ap.error("--health needs exactly one directory")
+        # validate_health_files alone, not the full sink gate: farm out-dirs
+        # carry health streams without ever being telemetry directories.
+        errors = sink.validate_health_files(args.paths[0])
+        if errors:
+            for e in errors:
+                print(f"INVALID: {e}", file=sys.stderr)
+            return 1
+        report_health(args.paths[0])
+        return 0
 
     if args.trace:
         if len(args.paths) != 1:
