@@ -461,8 +461,11 @@ def _tiny_avals():
 
 def entry_points():
     """(label, expected status, lower thunk) for every jitted entry point the
-    donation pin covers. Expectations are design decisions, restated here so
-    the golden regeneration and the rule messages agree:
+    donation pin covers. Labels and expectations come from the single-source
+    registry `policy.donating_entry_points()` (Pass D's dataflow lint and the
+    runtime sanitizer read the SAME registry); only the tiny-aval lower thunks
+    live here. Expectations are design decisions, restated so the golden
+    regeneration and the rule messages agree:
 
       _chunk_donate  donates the chunk carry (the long-horizon hot loop)
       _chunk_t_donate  the telemetry soak loop's chunk: same donation contract
@@ -475,6 +478,10 @@ def entry_points():
       simulate(+scenario)  seed/genome inputs only -- nothing donatable; the
                      scan carry double-buffers inside one executable, which
                      is XLA's job, not the caller's
+
+    Only `cost_pinned` registry entries appear (the trace variant shares
+    `_chunk_t_donate`'s donation decorator line and is covered by Pass D's
+    registry-coverage rule instead of a second golden row).
     """
     import dataclasses as _dc
 
@@ -486,25 +493,30 @@ def entry_points():
     genome = jaxpr_audit._genome_avals(_TINY_BATCH, 2)
     serve_cfg = _dc.replace(_TINY_CFG, serve_ingest=True)
     cmds = jax.ShapeDtypeStruct((_TINY_TICKS, _TINY_BATCH), jnp.int32)
-    return (
-        ("sim.chunked._chunk_donate", "donated",
-         lambda: chunked._chunk_donate.lower(
-             _TINY_CFG, state, keys, _TINY_TICKS, None, 1)),
-        ("sim.telemetry._chunk_t_donate", "donated",
-         lambda: telemetry._chunk_t_donate.lower(
-             _TINY_CFG, state, keys, None, _TINY_TICKS, _TINY_TICKS, 0, None, 1)),
-        ("serve.loop._serve_chunk", "donated",
-         lambda: serve_loop._serve_chunk.lower(
-             serve_cfg, state, keys, cmds, None, _TINY_TICKS)),
-        ("sim.chunked._chunk", "not-donated",
-         lambda: chunked._chunk.lower(
-             _TINY_CFG, state, keys, _TINY_TICKS, None, 1)),
-        ("sim.scan.simulate", "not-donated",
-         lambda: scan_mod.simulate.lower(
-             _TINY_CFG, seed, _TINY_BATCH, _TINY_TICKS)),
-        ("sim.scan.simulate_scenario", "not-donated",
-         lambda: scan_mod.simulate_scenario.lower(
-             _TINY_CFG, seed, _TINY_BATCH, _TINY_TICKS, genome, 16)),
+    thunks = {
+        "sim.chunked._chunk_donate":
+            lambda: chunked._chunk_donate.lower(
+                _TINY_CFG, state, keys, _TINY_TICKS, None, 1),
+        "sim.telemetry._chunk_t_donate":
+            lambda: telemetry._chunk_t_donate.lower(
+                _TINY_CFG, state, keys, None, _TINY_TICKS, _TINY_TICKS, 0,
+                None, 1),
+        "serve.loop._serve_chunk":
+            lambda: serve_loop._serve_chunk.lower(
+                serve_cfg, state, keys, cmds, None, _TINY_TICKS),
+        "sim.chunked._chunk":
+            lambda: chunked._chunk.lower(
+                _TINY_CFG, state, keys, _TINY_TICKS, None, 1),
+        "sim.scan.simulate":
+            lambda: scan_mod.simulate.lower(
+                _TINY_CFG, seed, _TINY_BATCH, _TINY_TICKS),
+        "sim.scan.simulate_scenario":
+            lambda: scan_mod.simulate_scenario.lower(
+                _TINY_CFG, seed, _TINY_BATCH, _TINY_TICKS, genome, 16),
+    }
+    return tuple(
+        (e.label, e.expected, thunks[e.label])
+        for e in policy.donating_entry_points() if e.cost_pinned
     )
 
 

@@ -339,6 +339,74 @@ def schema_fingerprint() -> str:
     return hashlib.sha256(repr(rows).encode()).hexdigest()[:16]
 
 
+# ---------------------------------------------------- donation entry points
+
+class DonatingEntry:
+    """One jitted entry point covered by the donation policy.
+
+    `label` is the dotted name Pass C's donation golden pins; `path`/`func`
+    locate the definition for the Pass D dataflow lint; `donated_param` is the
+    parameter name `donate_argnums` targets (None for input-preserving
+    entries); `loops` names the standing-loop functions that call it -- the
+    scopes where a retained reference to the donated argument is a
+    use-after-donate race; `cost_pinned` says whether the entry appears in the
+    Pass C golden (the trace variant shares `_chunk_t_donate`'s donation
+    contract but is not separately pinned, so adding it cannot stale the
+    golden)."""
+
+    def __init__(self, label: str, path: str, func: str,
+                 donated_param: str | None, expected: str,
+                 loops: tuple[str, ...] = (), cost_pinned: bool = True):
+        self.label = label
+        self.path = path
+        self.func = func
+        self.donated_param = donated_param
+        self.expected = expected
+        self.loops = loops
+        self.cost_pinned = cost_pinned
+
+    def __repr__(self):  # test/debug readability only
+        return f"DonatingEntry({self.label!r}, {self.expected!r})"
+
+
+def donating_entry_points() -> tuple[DonatingEntry, ...]:
+    """The single source of truth for which entry points donate their carry.
+
+    Pass C (`cost_model.entry_points`) reads labels + expectations from here
+    and pins the lowering-level aliasing marks; Pass D
+    (`race_audit`/`sanitizer`) reads paths + donated parameter names from here
+    to drive the use-after-donate dataflow lint and the runtime
+    donation-poison harness. Adding a donating entry point in code without
+    registering it here fails Pass D's coverage check (rule
+    `race-unregistered-donation`)."""
+    return (
+        DonatingEntry(
+            "sim.chunked._chunk_donate", "raft_sim_tpu/sim/chunked.py",
+            "_chunk_donate", "state", "donated", loops=("run_chunked",)),
+        DonatingEntry(
+            "sim.telemetry._chunk_t_donate", "raft_sim_tpu/sim/telemetry.py",
+            "_chunk_t_donate", "state", "donated",
+            loops=("run_chunked_telemetry",)),
+        DonatingEntry(
+            "sim.telemetry._chunk_t_donate_trace",
+            "raft_sim_tpu/sim/telemetry.py", "_chunk_t_donate_trace", "state",
+            "donated", loops=("run_chunked_telemetry",), cost_pinned=False),
+        DonatingEntry(
+            "serve.loop._serve_chunk", "raft_sim_tpu/serve/loop.py",
+            "_serve_chunk", "state", "donated",
+            loops=("_dispatch", "serve", "drain")),
+        DonatingEntry(
+            "sim.chunked._chunk", "raft_sim_tpu/sim/chunked.py",
+            "_chunk", None, "not-donated"),
+        DonatingEntry(
+            "sim.scan.simulate", "raft_sim_tpu/sim/scan.py",
+            "simulate", None, "not-donated"),
+        DonatingEntry(
+            "sim.scan.simulate_scenario", "raft_sim_tpu/sim/scan.py",
+            "simulate_scenario", None, "not-donated"),
+    )
+
+
 def expected_checkpoint_keys() -> set[str]:
     """The npz key set `checkpoint.save` must produce for its field sets --
     derived the same way save() derives it, so a serializer change that

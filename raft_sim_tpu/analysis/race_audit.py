@@ -1,0 +1,898 @@
+"""Pass D: host<->device concurrency audit -- the static legs.
+
+Four standing loops donate their fleet carry between chunks
+(`sim/chunked.run_chunked`, `sim/telemetry.run_chunked_telemetry`,
+`serve/loop.ServeSession`, and -- via the non-donating eval path --
+`farm/core.run_farm`) while host code deliberately works INSIDE the
+dispatch->sync window (the serve loop's overlapped export/pack, the health
+plane's folds, the evidence hooks). The race class this invites is
+use-after-donate: a host reference still pointing at buffers the previous
+dispatch handed back to XLA. On backends that really alias (TPU), a late read
+returns torn or recycled memory; on CPU, donation is ignored and the bug sits
+latent until the first chip session. This pass makes the discipline a gated
+fact instead of docstring prose. All rules are host-side AST dataflow -- no
+lowering, no execution -- so the whole pass runs in well under a second.
+
+Rules:
+
+  race-use-after-donate      a reference aliasing a donated argument (the
+                             name itself, a view derived from it, or a closure
+                             that captured it) is read or retained after the
+                             donating dispatch without being rebound from the
+                             call's outputs. Donating entry points are
+                             single-sourced from `policy.donating_entry_points`
+                             (the registry Pass C's donation audit reads), so
+                             the lint and the lowering pin can never cover
+                             different sets. Blessed idioms: `_own_copy`
+                             (the loops' up-front buffer-distinct copy) and
+                             fetch-before-donate (`DeltaStream.begin_rounds`/
+                             `finish_rounds`, enqueued on the device stream
+                             behind the chunk) never alias the dead carry.
+  race-window-mutation       host code between a donating dispatch and its
+                             sync point (the overlap window) rebinds, mutates,
+                             or deletes the in-flight carry root. The overlap
+                             write-set is derived statically
+                             (`overlap_write_sets`) and must stay disjoint
+                             from the donated carry's reachable set -- PR 11's
+                             "overlap is a perf.jsonl fact" as a CHECKED fact.
+  race-key-reuse             a PRNG key is consumed twice (double draw, double
+                             split, same-salt fold_in, or a draw mixed with
+                             any other consumption) in `sim/faults.py`,
+                             `scenario/`, or `farm/`. Deriving distinct
+                             streams -- one split plus fold_ins with distinct
+                             salts -- is the blessed discipline.
+  race-sink-writer           an append-mode `open()` on a telemetry/health
+                             stream outside the registered single-writer set
+                             (`APPEND_OWNERS`): each .jsonl stream has exactly
+                             one writer per scope (the truncate-on-rearm
+                             discipline HealthWriter/TelemetrySink follow).
+                             Stale registry rows are findings too.
+  race-unregistered-donation a `donate_argnums` entry point missing from
+                             `policy.donating_entry_points` (or a registered
+                             donating entry whose decorator is gone): the
+                             registry is self-checking in both directions.
+  race-donation-poison       the RUNTIME leg's rule (analysis/sanitizer.py):
+                             a sanitizer-armed standing-loop session either
+                             tripped a poisoned-buffer access or diverged from
+                             the plain run. Emitted by `tools/check.py --race
+                             --dynamic`, never by the static pass.
+
+Intentional exceptions go through the same waiver engine as Passes A/B/C
+(analysis/waivers.json); docs/ANALYSIS.md has the catalogue and the
+"writing overlap-safe host code" guidance.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import os
+
+from raft_sim_tpu.analysis import policy
+from raft_sim_tpu.analysis.findings import Finding
+
+# Every rule slug this pass can emit (run.run_all scopes stale-waiver
+# detection to the passes that actually ran). race-donation-poison belongs to
+# the dynamic leg (sanitizer.py) but is part of this pass's rule set.
+RULES = frozenset({
+    "race-use-after-donate", "race-window-mutation", "race-key-reuse",
+    "race-sink-writer", "race-unregistered-donation", "race-donation-poison",
+    "race-parse-error",
+})
+
+# Assigning THROUGH these calls never aliases the donated carry: _own_copy is
+# the loops' up-front buffer-distinct copy (sim/chunked.py).
+BLESSED_COPY_CALLS = frozenset({"_own_copy"})
+
+# Calls that end the dispatch->sync overlap window: the loop is provably
+# blocked on (a host copy of) the dispatched chunk's outputs after any of
+# these. `end` counts only with its `sync=` keyword (obs/timer.py ChunkTimer).
+SYNC_CALLS = frozenset({
+    "block_until_ready", "device_get", "drain", "finish_rounds", "_collect",
+})
+
+# Host-side method wrappers around a donating entry point: calling one kills
+# the named carry expression exactly like the entry point itself, and (when
+# rebinds is True) rebinds it to the new carry before returning. Keyed by
+# repo-relative path so a same-named method elsewhere is not misread.
+DONATING_WRAPPERS: dict[str, dict[str, str]] = {
+    "raft_sim_tpu/serve/loop.py": {"_dispatch": "self.state"},
+}
+
+# jax.random consumption classes for the key-stream discipline rule.
+_RANDOM_DRAWS = frozenset({
+    "bits", "bernoulli", "randint", "uniform", "normal", "choice",
+    "categorical", "permutation", "exponential", "gamma", "laplace",
+    "truncated_normal", "gumbel",
+})
+_RANDOM_CREATES = frozenset({"key", "PRNGKey", "wrap_key_data", "key_data"})
+
+# The single-writer registry: every append-mode open() of a stream file in
+# the package, keyed (repo-relative path, enclosing function). A second code
+# path appending to the same stream -- or an append site this table does not
+# know -- is a race-sink-writer finding; so is a stale row here. Stream names
+# are documentation (the site key is what is enforced).
+APPEND_OWNERS: dict[tuple[str, str], str] = {
+    ("raft_sim_tpu/serve/deltas.py", "append_delta_rows"): "deltas.jsonl",
+    ("raft_sim_tpu/serve/tenancy.py", "credit_windows"):
+        "tenants/<name>/windows.jsonl",
+    ("raft_sim_tpu/health/monitor.py", "append_health"): "health.jsonl",
+    ("raft_sim_tpu/health/monitor.py", "append_alert"): "alerts.jsonl",
+    ("raft_sim_tpu/farm/core.py", "append_hunt"):
+        "members/<name>/hunt.jsonl",
+    ("raft_sim_tpu/farm/core.py", "append_perf"): "perf.jsonl (farm dir)",
+    ("raft_sim_tpu/utils/telemetry_sink.py", "append_windows"):
+        "windows.jsonl",
+    ("raft_sim_tpu/utils/telemetry_sink.py", "append_perf"): "perf.jsonl",
+    ("raft_sim_tpu/utils/telemetry_sink.py", "append_trace"):
+        "trace.jsonl + trace_windows.jsonl",
+    ("raft_sim_tpu/utils/apply_log.py", "update"): "node_<i>.jsonl",
+}
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _dotted(node) -> str | None:
+    """Full dotted name of a Name/Attribute chain ('self.state'); None when
+    the base is not a plain name (call results, literals)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _collect_reads(node, out: list[str]) -> None:
+    """Maximal dotted names read inside an expression subtree. Subscripts read
+    their base ('x[i]' reads 'x') and their index; lambda bodies are included
+    with the lambda's own parameters shadowed out."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        d = _dotted(node)
+        if d is not None:
+            out.append(d)
+            return
+    if isinstance(node, ast.Subscript):
+        d = _dotted(node.value)
+        if d is not None:
+            out.append(d)
+        else:
+            _collect_reads(node.value, out)
+        _collect_reads(node.slice, out)
+        return
+    if isinstance(node, ast.Lambda):
+        inner: list[str] = []
+        _collect_reads(node.body, inner)
+        params = {a.arg for a in (
+            *node.args.posonlyargs, *node.args.args, *node.args.kwonlyargs)}
+        out.extend(d for d in inner if d.split(".")[0] not in params)
+        return
+    for child in ast.iter_child_nodes(node):
+        _collect_reads(child, out)
+
+
+def _flat_targets(node) -> list[str]:
+    """Dotted names an assignment target binds (tuple unpacking included)."""
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+        base = node.value if isinstance(node, ast.Subscript) else node
+        d = _dotted(base)
+        return [d] if d is not None else []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_flat_targets(elt))
+        return out
+    if isinstance(node, ast.Starred):
+        return _flat_targets(node.value)
+    return []
+
+
+def _call_name(call: ast.Call) -> str:
+    """Last segment of the called function's dotted name ('' if exotic)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+@functools.lru_cache(maxsize=None)
+def donating_signatures() -> dict:
+    """{func name: (donated arg index, donated param name, registry label)}
+    for every donated entry in `policy.donating_entry_points()`, with the
+    parameter index parsed from each entry's own source file (so the lint's
+    call-site matching can never disagree with the real signature)."""
+    repo = _repo_root()
+    sigs: dict[str, tuple[int, str, str]] = {}
+    for e in policy.donating_entry_points():
+        if e.donated_param is None:
+            continue
+        try:
+            with open(os.path.join(repo, e.path)) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name == e.func:
+                params = [a.arg for a in
+                          (*node.args.posonlyargs, *node.args.args)]
+                if e.donated_param in params:
+                    sigs[e.func] = (
+                        params.index(e.donated_param), e.donated_param,
+                        e.label,
+                    )
+                break
+    return sigs
+
+
+def _donated_arg_expr(call: ast.Call, idx: int, pname: str):
+    if idx < len(call.args) and not any(
+        isinstance(a, ast.Starred) for a in call.args[:idx + 1]
+    ):
+        return call.args[idx]
+    for kw in call.keywords:
+        if kw.arg == pname:
+            return kw.value
+    return None
+
+
+class _St:
+    """Dataflow state at one program point of the donation lint."""
+
+    __slots__ = ("dead", "anc", "window", "outs")
+
+    def __init__(self):
+        self.dead: dict[str, tuple[int, str]] = {}  # name -> (kill line, label)
+        self.anc: dict[str, set[str]] = {}          # name -> view ancestors
+        self.window: str | None = None              # in-flight carry root
+        self.outs: set[str] = set()                 # donating call's raw outputs
+
+    def copy(self) -> "_St":
+        st = _St()
+        st.dead = dict(self.dead)
+        st.anc = {k: set(v) for k, v in self.anc.items()}
+        st.window = self.window
+        st.outs = set(self.outs)
+        return st
+
+    def merge(self, other: "_St") -> None:
+        for k, v in other.dead.items():
+            self.dead.setdefault(k, v)
+        for k, v in other.anc.items():
+            self.anc.setdefault(k, set()).update(v)
+        self.window = self.window or other.window
+        self.outs |= other.outs
+
+
+def _is_prefix(name: str, root: str) -> bool:
+    return name == root or name.startswith(root + ".")
+
+
+class _DonationLint:
+    """Use-after-donate + overlap-window dataflow over one function body.
+
+    Statement-ordered walk (If branches forked and re-merged; loop bodies
+    containing a donating call walked twice, so statements textually BEFORE
+    the call are also checked in their post-donation next-iteration role).
+    """
+
+    def __init__(self, fn, path: str, findings: list[Finding],
+                 write_sets: dict | None = None):
+        self.fn = fn
+        self.path = path
+        self.findings = findings
+        self.sigs = donating_signatures()
+        self.wrappers = DONATING_WRAPPERS.get(path, {})
+        self.closures: list[tuple[int, set[str]]] = []
+        self.write_sets = write_sets
+
+    # ------------------------------------------------------------- plumbing
+
+    def run(self) -> None:
+        self._walk(self.fn.body, _St())
+
+    def _walk(self, stmts, st: _St) -> None:
+        for stmt in stmts:
+            self._proc(stmt, st)
+
+    def _walk_loop(self, body, st: _St) -> None:
+        self._walk(body, st)
+        if any(
+            isinstance(n, ast.Call)
+            and (_call_name(n) in self.sigs or _call_name(n) in self.wrappers)
+            for s in body for n in ast.walk(s)
+        ):
+            # Wraparound sweep: the loop's next iteration re-executes the
+            # statements before the donating call with the kill state live.
+            self._walk(body, st)
+
+    def _proc(self, stmt, st: _St) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            free = {
+                n.id for n in ast.walk(stmt) if isinstance(n, ast.Name)
+            } - {a.arg for a in (
+                *stmt.args.posonlyargs, *stmt.args.args, *stmt.args.kwonlyargs
+            )}
+            self.closures.append((stmt.lineno, free))
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, ast.If):
+            self._check_reads(stmt.test, st, stmt.lineno)
+            a, b = st.copy(), st.copy()
+            self._walk(stmt.body, a)
+            self._walk(stmt.orelse, b)
+            a.merge(b)
+            st.dead, st.anc, st.window, st.outs = a.dead, a.anc, a.window, a.outs
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_reads(stmt.iter, st, stmt.lineno)
+            self._walk_loop(stmt.body, st)
+            self._walk(stmt.orelse, st)
+            return
+        if isinstance(stmt, ast.While):
+            self._check_reads(stmt.test, st, stmt.lineno)
+            self._walk_loop(stmt.body, st)
+            self._walk(stmt.orelse, st)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_reads(item.context_expr, st, stmt.lineno)
+            self._walk(stmt.body, st)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk(stmt.body, st)
+            for h in stmt.handlers:
+                hv = st.copy()
+                self._walk(h.body, hv)
+                st.merge(hv)
+            self._walk(stmt.orelse, st)
+            self._walk(stmt.finalbody, st)
+            return
+        self._simple(stmt, st)
+
+    # ------------------------------------------------------ simple statements
+
+    def _simple(self, stmt, st: _St) -> None:
+        # Record escaping closures (late-binding: dangerous only for names the
+        # donation kill leaves dead, checked at kill time below).
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Lambda):
+                params = {a.arg for a in (
+                    *n.args.posonlyargs, *n.args.args, *n.args.kwonlyargs)}
+                free = {
+                    x.id for x in ast.walk(n.body) if isinstance(x, ast.Name)
+                } - params
+                self.closures.append((n.lineno, free))
+
+        targets: list[str] = []
+        if isinstance(stmt, ast.Assign):
+            self._check_reads(stmt.value, st, stmt.lineno)
+            for t in stmt.targets:
+                targets.extend(_flat_targets(t))
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._check_reads(stmt.value, st, stmt.lineno)
+            if isinstance(stmt, ast.AugAssign):
+                self._check_reads(stmt.target, st, stmt.lineno)
+            targets.extend(_flat_targets(stmt.target))
+        else:
+            self._check_reads(stmt, st, stmt.lineno)
+
+        donate = self._find_donating_call(stmt)
+
+        # Overlap write-set audit: writes landing inside the dispatch->sync
+        # window must stay disjoint from the in-flight carry.
+        if st.window is not None and targets:
+            if self.write_sets is not None:
+                self.write_sets.setdefault(
+                    f"{self.path}::{self.fn.name}", set()
+                ).update(targets)
+            allowed = donate is not None or self._carry_unpack(stmt, st)
+            if not allowed:
+                for t in targets:
+                    if _is_prefix(t, st.window) or _is_prefix(st.window, t):
+                        self.findings.append(Finding(
+                            rule="race-window-mutation",
+                            path=self.path,
+                            line=stmt.lineno,
+                            message=(
+                                f"`{t}` is written inside the dispatch->sync "
+                                f"overlap window of the in-flight carry "
+                                f"`{st.window}` in {self.fn.name}(): host "
+                                "code between a donating dispatch and its "
+                                "sync must never rebind or mutate the carry "
+                                "(docs/ANALYSIS.md, overlap-safe host code)"
+                            ),
+                        ))
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                for d in _flat_targets(t):
+                    if st.window is not None and _is_prefix(d, st.window):
+                        self.findings.append(Finding(
+                            rule="race-window-mutation",
+                            path=self.path,
+                            line=stmt.lineno,
+                            message=(
+                                f"`del {d}` inside the dispatch->sync window "
+                                f"of `{st.window}` in {self.fn.name}()"
+                            ),
+                        ))
+
+        if donate is not None:
+            call, dexpr, label, rebinds = donate
+            self._kill(stmt, call, dexpr, label, targets, st,
+                       rebinds=rebinds)
+        # Any rebinding resurrects the name (and everything under it).
+        for t in targets:
+            for k in [k for k in st.dead if _is_prefix(k, t)]:
+                del st.dead[k]
+            st.anc.pop(t, None)
+        # View-alias propagation: a call in the value produces fresh buffers
+        # (device_get/np.asarray/jnp copies); a pure name/attr/subscript chain
+        # is a VIEW of its roots and dies with them.
+        if isinstance(stmt, ast.Assign) and targets:
+            if not any(isinstance(n, ast.Call) for n in ast.walk(stmt.value)):
+                roots: list[str] = []
+                _collect_reads(stmt.value, roots)
+                anc = set()
+                for r in roots:
+                    anc.add(r)
+                    anc |= st.anc.get(r, set())
+                if anc:
+                    for t in targets:
+                        st.anc[t] = set(anc)
+
+        # Sync recognition closes the window (after the write check: a write
+        # in the same statement still happened pre-sync).
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                name = _call_name(n)
+                if name in SYNC_CALLS or (
+                    name == "end" and any(kw.arg == "sync" for kw in n.keywords)
+                ):
+                    st.window = None
+
+    def _carry_unpack(self, stmt, st: _St) -> bool:
+        """`state, m, ... = out` where `out` holds a donating call's raw
+        output tuple: the blessed rebind of the new carry."""
+        if not isinstance(stmt, ast.Assign):
+            return False
+        d = _dotted(stmt.value)
+        if d is not None and d in st.outs:
+            return True
+        call = next(
+            (n for n in ast.walk(stmt.value) if isinstance(n, ast.Call)), None)
+        return call is not None and _call_name(call) in BLESSED_COPY_CALLS
+
+    def _find_donating_call(self, stmt):
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n)
+            if name in self.wrappers:
+                # A donating-wrapper METHOD rebinds the carry to the new
+                # chunk's output before returning: the carry name survives,
+                # stale views/copies of the old carry do not.
+                return n, self.wrappers[name], f"{self.path}::{name}", True
+            if name in self.sigs:
+                idx, pname, label = self.sigs[name]
+                expr = _donated_arg_expr(n, idx, pname)
+                if expr is not None:
+                    d = _dotted(expr)
+                    if d is not None:
+                        return n, d, label, False
+        return None
+
+    def _kill(self, stmt, call, dexpr: str, label: str, targets, st: _St,
+              rebinds: bool = False):
+        newly = {dexpr}
+        for n, ancs in st.anc.items():
+            if any(_is_prefix(a, dexpr) or _is_prefix(dexpr, a) for a in ancs):
+                newly.add(n)
+        # Rebinding in the same statement keeps the name live (bound to the
+        # NEW carry from this call's outputs); a wrapper rebinds internally.
+        if rebinds:
+            newly = {k for k in newly if not _is_prefix(k, dexpr)}
+        for t in targets:
+            newly = {k for k in newly if not _is_prefix(k, t)}
+        # A closure that captured a name this kill leaves dead retains the
+        # donated buffers past the dispatch (late binding does not save it:
+        # the name is never rebound).
+        for cl_line, free in self.closures:
+            for k in sorted(newly):
+                if "." not in k and k in free:
+                    self.findings.append(Finding(
+                        rule="race-use-after-donate",
+                        path=self.path,
+                        line=cl_line,
+                        message=(
+                            f"closure defined at line {cl_line} captures "
+                            f"`{k}`, whose buffers are donated by "
+                            f"{label} at line {stmt.lineno} and never "
+                            f"rebound in {self.fn.name}(): fetch a host copy "
+                            "before the dispatch (jax.device_get / _own_copy)"
+                        ),
+                    ))
+        for k in newly:
+            st.dead[k] = (stmt.lineno, label)
+        if isinstance(stmt, ast.Assign):
+            st.outs = {t for t in targets if "." not in t}
+        st.window = dexpr
+
+    def _check_reads(self, node, st: _St, lineno: int) -> None:
+        if not st.dead:
+            return
+        reads: list[str] = []
+        _collect_reads(node, reads)
+        for d in reads:
+            for dd, (kline, label) in st.dead.items():
+                if _is_prefix(d, dd):
+                    self.findings.append(Finding(
+                        rule="race-use-after-donate",
+                        path=self.path,
+                        line=getattr(node, "lineno", lineno),
+                        message=(
+                            f"`{d}` is read after its buffers were donated "
+                            f"to {label} at line {kline} in "
+                            f"{self.fn.name}(): rebind it from the call's "
+                            "outputs, or take a host copy before the "
+                            "dispatch (jax.device_get / _own_copy)"
+                        ),
+                    ))
+                    break
+
+
+# ------------------------------------------------------- key-stream discipline
+
+
+class _KeyStreamLint:
+    """PRNG-key consumption discipline over one function: every jax.random
+    consumption site must come from a fresh split/fold_in. Illegal: a second
+    identical consumption (double draw, double split, same-salt fold_in) and
+    a draw mixed with ANY other consumption of the same key. Legal (the
+    faults.py idiom): one split plus fold_ins with distinct salts -- distinct
+    derived streams. Rebinding a key name resets its ledger
+    (`key, sub = split(key)` is the canonical refresh)."""
+
+    def __init__(self, fn, path: str, findings: list[Finding]):
+        self.fn = fn
+        self.path = path
+        self.findings = findings
+
+    def run(self) -> None:
+        self._walk(self.fn.body, {})
+
+    def _walk(self, stmts, ledger: dict) -> None:
+        for stmt in stmts:
+            self._proc(stmt, ledger)
+
+    def _proc(self, stmt, ledger: dict) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.If):
+            a = {k: dict(v) for k, v in ledger.items()}
+            b = {k: dict(v) for k, v in ledger.items()}
+            self._consume_in(stmt.test, a)
+            self._consume_in(stmt.test, b)
+            self._walk(stmt.body, a)
+            self._walk(stmt.orelse, b)
+            ledger.clear()
+            for src in (a, b):
+                for name, sigs in src.items():
+                    dst = ledger.setdefault(name, {})
+                    for sig, cnt in sigs.items():
+                        dst[sig] = max(dst.get(sig, 0), cnt)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            head = stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) else stmt.test
+            self._consume_in(head, ledger)
+            self._walk(stmt.body, ledger)
+            self._walk(stmt.orelse, ledger)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._walk(stmt.body, ledger)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk(stmt.body, ledger)
+            for h in stmt.handlers:
+                self._walk(h.body, ledger)
+            self._walk(stmt.orelse, ledger)
+            self._walk(stmt.finalbody, ledger)
+            return
+        self._consume_in(stmt, ledger)
+        targets: list[str] = []
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                targets.extend(_flat_targets(t))
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets.extend(_flat_targets(stmt.target))
+        for t in targets:
+            ledger.pop(t, None)
+
+    def _consume_in(self, node, ledger: dict) -> None:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fname = _call_name(n)
+            parent = (
+                _dotted(n.func.value)
+                if isinstance(n.func, ast.Attribute) else None
+            )
+            # Only jax.random.* (or an explicit `random.` / `jrandom.` alias)
+            # consumption sites count; same-named methods elsewhere do not.
+            if parent is None or "random" not in parent.split("."):
+                continue
+            if fname in _RANDOM_CREATES:
+                continue
+            if fname in _RANDOM_DRAWS:
+                sig = ("draw",)
+            elif fname == "split":
+                sig = ("split",)
+            elif fname == "fold_in":
+                salt = ast.unparse(n.args[1]) if len(n.args) > 1 else "?"
+                sig = ("fold", salt)
+            else:
+                continue
+            key = n.args[0] if n.args else None
+            if key is None:
+                for kw in n.keywords:
+                    if kw.arg == "key":
+                        key = kw.value
+            kname = _dotted(key) if key is not None else None
+            if kname is None:
+                continue
+            sigs = ledger.setdefault(kname, {})
+            prior_draw = sigs.get(("draw",), 0) > 0
+            sigs[sig] = sigs.get(sig, 0) + 1
+            reuse = sigs[sig] > 1 or (
+                sig == ("draw",) and len(sigs) > 1
+            ) or (sig != ("draw",) and prior_draw)
+            if reuse:
+                self.findings.append(Finding(
+                    rule="race-key-reuse",
+                    path=self.path,
+                    line=n.lineno,
+                    message=(
+                        f"PRNG key `{kname}` is consumed again "
+                        f"({fname}) in {self.fn.name}() after an earlier "
+                        "consumption: every jax.random call needs a fresh "
+                        "split/fold_in stream -- a reused key repeats the "
+                        "same randomness (sim/faults.py key discipline)"
+                    ),
+                ))
+
+
+# ------------------------------------------------------------ per-file lints
+
+
+def _lint_donation(tree, path: str, findings: list[Finding],
+                   write_sets: dict | None = None) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _DonationLint(node, path, findings, write_sets).run()
+
+
+def _key_scope(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return (
+        path.endswith("sim/faults.py")
+        or "scenario" in parts
+        or "farm" in parts
+    )
+
+
+def _lint_keys(tree, path: str, findings: list[Finding]) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _KeyStreamLint(node, path, findings).run()
+
+
+def _append_sites(tree, path: str):
+    """(func name, lineno, stream hint) for every append-mode open() in the
+    file, with the innermost enclosing function resolved by a parent walk."""
+    func_of: dict[int, str] = {}
+
+    def mark(node, fname):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mark(child, child.name)
+            else:
+                mark(child, fname)
+        func_of[id(node)] = fname
+
+    mark(tree, "<module>")
+    sites = []
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call) and _call_name(n) == "open"):
+            continue
+        mode = None
+        if len(n.args) > 1 and isinstance(n.args[1], ast.Constant):
+            mode = n.args[1].value
+        for kw in n.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if not (isinstance(mode, str) and "a" in mode):
+            continue
+        hint = next(
+            (c.value for c in ast.walk(n)
+             if isinstance(c, ast.Constant) and isinstance(c.value, str)
+             and c.value.endswith(".jsonl")),
+            "<unresolved stream>",
+        )
+        sites.append((func_of.get(id(n), "<module>"), n.lineno, hint))
+    return sites
+
+
+def _lint_sink_sites(tree, path: str, findings: list[Finding]):
+    sites = _append_sites(tree, path)
+    for fname, lineno, hint in sites:
+        if (path, fname) not in APPEND_OWNERS:
+            findings.append(Finding(
+                rule="race-sink-writer",
+                path=path,
+                line=lineno,
+                message=(
+                    f"append-mode open() of {hint} in {fname}() is not in the "
+                    "single-writer registry (race_audit.APPEND_OWNERS): each "
+                    ".jsonl stream has exactly one writer per scope -- "
+                    "register the owner (with justification) or route the "
+                    "rows through the existing writer"
+                ),
+            ))
+    return sites
+
+
+def _donate_decorated(tree, path: str):
+    """(func name, lineno) of every function carrying a donate_argnums
+    decorator in the file."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if any(
+                isinstance(kw, ast.keyword) and kw.arg == "donate_argnums"
+                for c in ast.walk(dec) if isinstance(c, ast.Call)
+                for kw in c.keywords
+            ):
+                out.append((node.name, node.lineno))
+    return out
+
+
+def _lint_donate_registry(tree, path: str, findings: list[Finding]):
+    decorated = _donate_decorated(tree, path)
+    registered = {
+        e.func for e in policy.donating_entry_points()
+        if e.path == path and e.expected == "donated"
+    }
+    for fname, lineno in decorated:
+        if fname not in registered:
+            findings.append(Finding(
+                rule="race-unregistered-donation",
+                path=path,
+                line=lineno,
+                message=(
+                    f"{fname}() has donate_argnums but is not in "
+                    "policy.donating_entry_points: register it so the "
+                    "use-after-donate lint and the runtime sanitizer cover "
+                    "it (and Pass C can pin its aliasing)"
+                ),
+            ))
+    return decorated
+
+
+def _dedupe(findings: list[Finding]) -> list[Finding]:
+    seen, out = set(), []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def lint_source(source: str, path: str,
+                write_sets: dict | None = None) -> list[Finding]:
+    """All per-file Pass D rules over one file's text. `path` (repo-relative)
+    anchors findings and scopes the key-stream rule; tree-level reverse
+    checks (stale APPEND_OWNERS rows, registry entries whose decorator is
+    gone) live in `run_pass`."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as ex:
+        return [Finding(rule="race-parse-error", path=path, line=ex.lineno or 0,
+                        message=f"does not parse: {ex.msg}")]
+    findings: list[Finding] = []
+    _lint_donation(tree, path, findings, write_sets)
+    if _key_scope(path):
+        _lint_keys(tree, path, findings)
+    _lint_sink_sites(tree, path, findings)
+    _lint_donate_registry(tree, path, findings)
+    return _dedupe(findings)
+
+
+def _iter_package_files(root: str):
+    repo = os.path.dirname(os.path.abspath(root.rstrip("/")))
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if not d.startswith("__pycache__"))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                yield full, os.path.relpath(full, repo)
+
+
+def lint_tree(root: str, write_sets: dict | None = None) -> list[Finding]:
+    """Per-file rules over every .py file under `root` (the raft_sim_tpu
+    package dir) plus the tree-level reverse checks."""
+    findings: list[Finding] = []
+    seen_appends: set[tuple[str, str]] = set()
+    seen_decorated: set[tuple[str, str]] = set()
+    for full, rel in _iter_package_files(root):
+        with open(full) as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as ex:
+            findings.append(Finding(
+                rule="race-parse-error", path=rel, line=ex.lineno or 0,
+                message=f"does not parse: {ex.msg}"))
+            continue
+        _lint_donation(tree, rel, findings, write_sets)
+        if _key_scope(rel):
+            _lint_keys(tree, rel, findings)
+        for fname, _, _ in _lint_sink_sites(tree, rel, findings):
+            seen_appends.add((rel, fname))
+        for fname, _ in _lint_donate_registry(tree, rel, findings):
+            seen_decorated.add((rel, fname))
+    for (path, fname), stream in sorted(APPEND_OWNERS.items()):
+        if (path, fname) not in seen_appends:
+            findings.append(Finding(
+                rule="race-sink-writer",
+                path=path,
+                message=(
+                    f"APPEND_OWNERS registers {fname}() as the writer of "
+                    f"{stream} but no append-mode open() exists there: "
+                    "remove the stale registry row"
+                ),
+            ))
+    for e in policy.donating_entry_points():
+        if e.expected != "donated":
+            continue
+        if (e.path, e.func) not in seen_decorated:
+            findings.append(Finding(
+                rule="race-unregistered-donation",
+                path=e.path,
+                message=(
+                    f"policy.donating_entry_points registers {e.func}() as "
+                    "donating but it carries no donate_argnums decorator "
+                    f"in {e.path}: fix the registry or the entry point"
+                ),
+            ))
+    return _dedupe(findings)
+
+
+def overlap_write_sets(package_root: str | None = None) -> dict[str, list[str]]:
+    """The statically derived overlap write-set: for every function that
+    dispatches a donating chunk, the host names written between dispatch and
+    sync. The race-window-mutation rule proves each set disjoint from the
+    in-flight carry; this surface is for docs/tests (the checked fact,
+    printable)."""
+    if package_root is None:
+        package_root = os.path.join(_repo_root(), "raft_sim_tpu")
+    sets: dict[str, set[str]] = {}
+    lint_tree(package_root, write_sets=sets)
+    return {k: sorted(v) for k, v in sorted(sets.items())}
+
+
+def run_pass(package_root: str) -> list[Finding]:
+    """The full static Pass D (the dynamic donation-poison leg is
+    analysis/sanitizer.py, run via `tools/check.py --race --dynamic`)."""
+    return lint_tree(package_root)

@@ -26,6 +26,8 @@ def run_all(
     do_ast: bool = True,
     do_jaxpr: bool = True,
     do_cost: bool = True,
+    do_race: bool = True,
+    do_dynamic: bool = False,
     config_names=jaxpr_audit.AUDIT_CONFIGS,
     waivers_path: str | None = DEFAULT_WAIVERS,
 ):
@@ -33,13 +35,19 @@ def run_all(
     timings): `problems` are waiver-file format errors (always fatal for the
     CLI -- a typo'd waiver must not silently stop waiving); `timings` is
     {pass name: wall seconds} for the passes that ran (the CI artifact
-    records it, and tests/test_cost_model.py pins the analyzer's budget)."""
-    from raft_sim_tpu.analysis import cost_model
+    records it, and tests/test_cost_model.py pins the analyzer's budget).
+    `do_dynamic` adds Pass D's runtime donation-poison leg (short
+    sanitizer-armed standing-loop sessions -- the only part of the gate that
+    executes device code beyond tiny donation probes)."""
+    from raft_sim_tpu.analysis import cost_model, race_audit
 
     found: list[F.Finding] = []
     active_rules: set[str] = set()
     timings: dict[str, float] = {}
-    all_rules = ast_lint.RULES | jaxpr_audit.RULES | cost_model.RULES
+    all_rules = (
+        ast_lint.RULES | jaxpr_audit.RULES | cost_model.RULES
+        | race_audit.RULES
+    )
     if do_ast:
         t0 = time.monotonic()
         found.extend(ast_lint.run_pass(package_root()))
@@ -55,6 +63,16 @@ def run_all(
         found.extend(cost_model.run_pass(config_names))
         timings["cost"] = round(time.monotonic() - t0, 2)
         active_rules |= cost_model.RULES
+    if do_race:
+        t0 = time.monotonic()
+        found.extend(race_audit.run_pass(package_root()))
+        if do_dynamic:
+            from raft_sim_tpu.analysis import sanitizer
+
+            dyn_findings, _info = sanitizer.run_dynamic()
+            found.extend(dyn_findings)
+        timings["race"] = round(time.monotonic() - t0, 2)
+        active_rules |= race_audit.RULES
     unused: list[dict] = []
     problems: list[str] = []
     if waivers_path:
@@ -63,7 +81,7 @@ def run_all(
         # A waiver is only STALE if the pass owning its rule actually ran (a
         # --jaxpr-only run must not condemn the AST pass's waivers). A rule
         # no pass knows -- a typo -- is stale whenever the full gate ran.
-        full = do_ast and do_jaxpr and do_cost
+        full = do_ast and do_jaxpr and do_cost and do_race
         unused = [
             w for w in unused
             if w.get("rule") in active_rules

@@ -1,0 +1,270 @@
+"""Pass D, runtime leg: the donation-poison sanitizer.
+
+The static lint (analysis/race_audit.py) proves the SOURCE respects donation
+discipline; this harness proves the RUNNING loops do. Arming it makes every
+run behave like the strictest possible donating backend: each registered
+donating entry point is wrapped, and as soon as a chunk's outputs are
+materialized the wrapper POISONS the donated argument's buffers
+(`jax.Array.delete()` -- the same deletion real donation performs). Any late
+host access then raises "Array has been deleted" at the exact access site,
+instead of silently reading stale memory on hardware. Current JAX already
+invalidates donated inputs at dispatch even on CPU (where aliasing is
+ignored), so the poison is the BACKSTOP for any path where donation was
+dropped or unusable; what arming adds on every backend is the coverage
+counters, the forced dispatch->sync serialization, and the bit-exactness
+pin below -- the timing half of the race class that deletion alone cannot
+exercise.
+
+Wrapping is by module-global patch of the entry points registered in
+`policy.donating_entry_points` -- the same single-sourced registry the static
+lint and Pass C's aliasing pin read -- so a new donating entry point is
+covered by all three the moment it is registered (and flagged by
+`race-unregistered-donation` the moment it is not). The wrapper syncs on the
+chunk's outputs before poisoning (`jax.block_until_ready`), which serializes
+the dispatch->sync overlap but changes no value: sanitizer-armed runs are
+bit-exact against plain runs, and `run_dynamic` pins exactly that for each
+standing loop (rule `race-donation-poison` on any raise or divergence).
+
+`farm/core.run_farm` has no donating entry point of its own -- members
+evaluate through the non-donating `telemetry.simulate_windowed` /
+`mesh.simulate_windowed_sharded` paths and hold genomes, not fleet carries --
+so its coverage is the registry's `not-donated` rows plus the static lint
+over `farm/core.py`; `run_dynamic` records that rationale in its info dict
+rather than inventing a donation to poison.
+
+Entry points: `tools/check.py --race --dynamic` (findings engine) and
+`driver.py run/serve --sanitize` (arm a real session).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import importlib
+
+import jax
+import numpy as np
+
+from raft_sim_tpu.analysis import race_audit
+from raft_sim_tpu.analysis import policy
+from raft_sim_tpu.analysis.findings import Finding
+
+
+def _poison(tree) -> tuple[int, int]:
+    """Delete every live jax.Array buffer in `tree` (what real donation does
+    the moment the donated program runs); return (poisoned, already_deleted).
+    Current JAX invalidates donated inputs at dispatch even on CPU, so in
+    the common case every leaf lands in the second bucket and the delete()
+    is the backstop for any path where donation was dropped or unusable --
+    the counters prove which regime the run was in."""
+    poisoned = already = 0
+    for leaf in jax.tree.leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        if leaf.is_deleted():
+            already += 1
+        else:
+            leaf.delete()
+            poisoned += 1
+    return poisoned, already
+
+
+def _wrap(real, idx: int, pname: str, label: str, stats: dict):
+    @functools.wraps(real)
+    def wrapper(*args, **kwargs):
+        donated = kwargs.get(pname)
+        if donated is None and idx < len(args):
+            donated = args[idx]
+        out = real(*args, **kwargs)
+        # Materialize the chunk's outputs first: poisoning must emulate
+        # donation (input buffers recycled), never corrupt the computation.
+        jax.block_until_ready(out)
+        stats["calls"][label] = stats["calls"].get(label, 0) + 1
+        if donated is not None:
+            poisoned, already = _poison(donated)
+            stats["poisoned"] += poisoned
+            stats["pre_deleted"] += already
+        return out
+
+    # The loops' recompile watchdog probes resolve these module globals at
+    # call time and read the jit cache size through them.
+    if hasattr(real, "_cache_size"):
+        wrapper._cache_size = real._cache_size
+    wrapper._race_sanitizer_real = real
+    return wrapper
+
+
+@contextlib.contextmanager
+def armed():
+    """Patch every registered donating entry point with the poisoning
+    wrapper for the duration of the block. Yields the stats dict
+    ({'calls': {label: n}, 'poisoned': total buffers deleted}) so callers
+    can prove the harness actually covered their loop. Reentrant arming is
+    a no-op for already-armed entries."""
+    stats = {"calls": {}, "poisoned": 0, "pre_deleted": 0}
+    sigs = race_audit.donating_signatures()
+    patched = []
+    for e in policy.donating_entry_points():
+        if e.expected != "donated" or e.func not in sigs:
+            continue
+        mod = importlib.import_module(e.path[:-3].replace("/", "."))
+        real = getattr(mod, e.func)
+        if hasattr(real, "_race_sanitizer_real"):
+            continue
+        idx, pname, _ = sigs[e.func]
+        setattr(mod, e.func, _wrap(real, idx, pname, e.label, stats))
+        patched.append((mod, e.func, real))
+    try:
+        yield stats
+    finally:
+        for mod, name, real in patched:
+            setattr(mod, name, real)
+
+
+# --------------------------------------------------------- bit-exactness pin
+
+
+def mismatched_leaves(a, b) -> list[str]:
+    """Paths of leaves where two pytrees are not bit-identical (after a host
+    fetch). Empty list == bit-exact."""
+    fa = jax.tree_util.tree_flatten_with_path(jax.device_get(a))[0]
+    fb = jax.tree_util.tree_flatten_with_path(jax.device_get(b))[0]
+    if len(fa) != len(fb):
+        return ["<tree structure differs>"]
+    bad = []
+    for (pa, la), (_, lb) in zip(fa, fb):
+        xa, xb = np.asarray(la), np.asarray(lb)
+        if xa.dtype != xb.dtype or xa.shape != xb.shape or not np.array_equal(
+            xa, xb
+        ):
+            bad.append(jax.tree_util.keystr(pa))
+    return bad
+
+
+# ----------------------------------------------------------- the dynamic leg
+
+
+_TINY_TICKS = 8
+_TINY_CHUNK = 4
+_TINY_BATCH = 2
+
+
+def _tiny_cfg():
+    from raft_sim_tpu.utils.config import RaftConfig
+
+    return RaftConfig(n_nodes=3, log_capacity=4, max_entries_per_rpc=1)
+
+
+def _leg_chunked():
+    from raft_sim_tpu.sim import chunked
+    from raft_sim_tpu.types import init_batch
+
+    cfg = _tiny_cfg()
+    state0 = init_batch(cfg, jax.random.key(0), _TINY_BATCH)
+    keys = jax.random.split(jax.random.key(1), _TINY_BATCH)
+
+    def once():
+        return chunked.run_chunked(
+            cfg, state0, keys, _TINY_TICKS, chunk=_TINY_CHUNK)
+
+    return "sim.chunked.run_chunked", "raft_sim_tpu/sim/chunked.py", once
+
+
+def _leg_telemetry():
+    from raft_sim_tpu.sim import telemetry
+    from raft_sim_tpu.types import init_batch
+
+    cfg = _tiny_cfg()
+    state0 = init_batch(cfg, jax.random.key(0), _TINY_BATCH)
+    keys = jax.random.split(jax.random.key(1), _TINY_BATCH)
+
+    def once():
+        return telemetry.run_chunked_telemetry(
+            cfg, state0, keys, _TINY_TICKS, _TINY_CHUNK, chunk=_TINY_CHUNK)
+
+    return (
+        "sim.telemetry.run_chunked_telemetry",
+        "raft_sim_tpu/sim/telemetry.py",
+        once,
+    )
+
+
+def _leg_serve():
+    from raft_sim_tpu.serve import loop
+    from raft_sim_tpu.serve.ingest import CommandSource
+
+    cfg = _tiny_cfg()
+
+    def once():
+        sess = loop.ServeSession(
+            cfg, batch=_TINY_BATCH, seed=3, chunk=8, window=4, delta_depth=4)
+        stats = sess.serve(
+            CommandSource(iter([7, 1, 2, 9])), drain_chunks=2)
+        # Wall-clock fields are the one thing arming legitimately changes
+        # (the overlap is serialized); every counter must stay bit-exact.
+        stats = {k: v for k, v in stats.items() if not k.endswith("_s")}
+        return sess.state, stats
+
+    return "serve.loop.ServeSession.serve", "raft_sim_tpu/serve/loop.py", once
+
+
+def run_dynamic() -> tuple[list[Finding], dict]:
+    """Run each donating standing loop one short session plain, then the same
+    session sanitizer-armed, and pin (a) the armed run neither raises a
+    poisoned-buffer access nor diverges, (b) the wrapper actually fired (the
+    harness covered the loop). Any violation is a `race-donation-poison`
+    finding naming the loop. Returns (findings, info) where info carries the
+    per-loop call/poison counters and the farm-coverage rationale."""
+    findings: list[Finding] = []
+    info: dict = {
+        "farm": (
+            "no donating entry point (members evaluate via non-donating "
+            "simulate_windowed); covered by the registry's not-donated rows "
+            "and the static lint"
+        ),
+        "loops": {},
+    }
+    for label, path, once in (_leg_chunked(), _leg_telemetry(), _leg_serve()):
+        plain = once()
+        try:
+            with armed() as stats:
+                poisoned = once()
+        except Exception as ex:  # noqa: BLE001 -- the raise IS the finding
+            findings.append(Finding(
+                rule="race-donation-poison",
+                path=path,
+                message=(
+                    f"{label}: sanitizer-armed session raised "
+                    f"{type(ex).__name__}: {ex} -- a host access touched a "
+                    "donated buffer after its dispatch (use-after-donate "
+                    "that real donation would corrupt silently)"
+                ),
+            ))
+            continue
+        info["loops"][label] = {
+            "calls": dict(stats["calls"]),
+            "poisoned_buffers": stats["poisoned"],
+            "pre_deleted_buffers": stats["pre_deleted"],
+        }
+        if not stats["calls"]:
+            findings.append(Finding(
+                rule="race-donation-poison",
+                path=path,
+                message=(
+                    f"{label}: sanitizer-armed session never hit a wrapped "
+                    "donating entry point -- the harness is not covering "
+                    "this loop (registry or loop wiring drifted)"
+                ),
+            ))
+        bad = mismatched_leaves(plain, poisoned)
+        if bad:
+            findings.append(Finding(
+                rule="race-donation-poison",
+                path=path,
+                message=(
+                    f"{label}: sanitizer-armed run diverged from the plain "
+                    f"run at {len(bad)} leaves (first: {bad[0]}) -- arming "
+                    "must only serialize the overlap, never change a value"
+                ),
+            ))
+    return findings, info

@@ -691,6 +691,32 @@ def _profile_ctx(path: str | None):
     return jax.profiler.trace(path, create_perfetto_trace=True)
 
 
+def _sanitize_ctx(args):
+    """The --sanitize arming context, shared by run/serve: the donation-poison
+    sanitizer over every registered donating entry point
+    (analysis/sanitizer.py), or a no-op without the flag. Yields the
+    sanitizer's coverage stats (None when unarmed)."""
+    import contextlib
+
+    if not getattr(args, "sanitize", False):
+        return contextlib.nullcontext()
+    from raft_sim_tpu.analysis import sanitizer
+
+    return sanitizer.armed()
+
+
+def _sanitize_report(args, san) -> None:
+    if san is None:
+        return
+    calls = ", ".join(f"{k}x{v}" for k, v in sorted(san["calls"].items()))
+    print(
+        f"sanitizer: clean ({calls or 'no donating dispatches'}; "
+        f"{san['pre_deleted']} buffers invalidated by donation, "
+        f"{san['poisoned']} poisoned as backstop)",
+        file=sys.stderr,
+    )
+
+
 _FLAG_TYPES = {"int": int, "float": float}
 
 
@@ -1080,13 +1106,14 @@ def _serve(args, ap) -> int:
             )
 
     try:
-        with _profile_ctx(args.profile):
+        with _profile_ctx(args.profile), _sanitize_ctx(args) as san:
             stats = sess.serve(
                 source, chunks=args.chunks, drain_chunks=args.drain_chunks,
                 progress=progress,
             )
     except ValueError as ex:
         ap.error(str(ex))
+    _sanitize_report(args, san)
     out = summarize(sess.metrics)._asdict()
     out.update(stats)
     if stats["wall_s"] > 0:
@@ -1201,6 +1228,16 @@ def main(argv=None) -> int:
                             "flight-ring snapshots. Host-side only: "
                             "trajectories stay bit-exact vs an unmonitored "
                             "run")
+    run_p.add_argument("--sanitize", action="store_true",
+                       help="arm the donation-poison sanitizer "
+                            "(analysis/sanitizer.py): every donating chunk "
+                            "dispatch deletes its donated input buffers the "
+                            "moment the outputs land, so any host "
+                            "use-after-donate raises at the access site "
+                            "instead of reading stale memory on a real "
+                            "donating backend. Serializes the "
+                            "dispatch->sync overlap (debug mode, not for "
+                            "benchmarking); values stay bit-exact")
     _add_config_flags(run_p)
 
     sub.add_parser("presets", help="list the BASELINE config presets")
@@ -1274,6 +1311,12 @@ def main(argv=None) -> int:
                               "session into DIR (view with tensorboard/"
                               "xprof); capture is bit-exact vs no capture "
                               "(tier-1 pinned)")
+    serve_p.add_argument("--sanitize", action="store_true",
+                         help="arm the donation-poison sanitizer over the "
+                              "serving loop (analysis/sanitizer.py): late "
+                              "host access to a donated carry raises at the "
+                              "access site. Serializes the serve overlap "
+                              "(debug mode); stats stay bit-exact")
     _add_config_flags(serve_p)
 
     sc = sub.add_parser(
@@ -1570,13 +1613,14 @@ def main(argv=None) -> int:
             ap.error(str(ex))
 
     t0 = time.perf_counter()
-    with _profile_ctx(args.profile):
+    with _profile_ctx(args.profile), _sanitize_ctx(args) as san:
         sess.run(args.ticks, chunk=args.chunk, progress=args.progress)
         # Time to the host-side rollup, not block_until_ready: this TPU stack's
         # block can return before execution finishes (see bench.py docstring);
         # summary()'s device_get provably waits for real data.
         out = sess.summary()
     dt = time.perf_counter() - t0
+    _sanitize_report(args, san)
     out["wall_s"] = round(dt, 3)
     out["cluster_ticks_per_s"] = round(sess.batch * args.ticks / dt, 1)
     if args.perf:

@@ -136,7 +136,9 @@ class FarmSink:
     """Writer half of the farm's out-dir schema (module docstring). Creating
     one truncates the streams, telemetry-sink style; it also speaks the
     ChunkTimer sink protocol (append_perf), so the PR 8 timer streams
-    perf.jsonl rows here directly."""
+    perf.jsonl rows here directly. append_hunt/append_perf are this scope's
+    REGISTERED single writers (analysis Pass D, rule `race-sink-writer`):
+    a second code path appending to these streams is a gated finding."""
 
     def __init__(self, directory: str, members: list[dict]):
         import shutil
@@ -237,6 +239,14 @@ def run_farm(
     the farm write NEW artifacts into it (checker-gated). `perf` is an
     obs.ChunkTimer; with an `out_dir` and no timer, the farm makes its own
     and streams perf.jsonl there.
+
+    Concurrency posture (analysis Pass D): the farm is the one standing loop
+    WITHOUT a donating entry point -- members evaluate genomes through the
+    non-donating `telemetry.simulate_windowed` / mesh variants and fetch
+    metrics by `jax.device_get`, so there is no dispatch->sync carry window
+    to race. The registry rows in `policy.donating_entry_points` pin that
+    as `not-donated`; the key-stream discipline lint (`race-key-reuse`)
+    covers this package's PRNG handling instead.
 
     Hit processing is BOUNDED, not exhaustive: each generation, each
     member's FIRST violating cluster is shrunk (one ablation ladder per

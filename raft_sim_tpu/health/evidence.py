@@ -78,7 +78,13 @@ def write_bundle(
 ) -> str:
     """Write one bundle. `flights` maps global cluster id -> (ticks, StepInfo)
     as returned by telemetry.export_cluster; `refs` carries run identity
-    (config_hash, seed, checkpoint path...). Returns the directory."""
+    (config_hash, seed, checkpoint path...). Returns the directory.
+
+    Everything handed in must already be HOST data: the capture hooks run
+    inside the standing loops' chunk callbacks, where the device carry is
+    only valid until the callback returns (and is deleted outright under the
+    donation-poison sanitizer). export_cluster/device_get at capture time is
+    the contract Pass D's use-after-donate lint enforces on the callers."""
     from raft_sim_tpu.utils.telemetry_sink import flight_lines
 
     os.makedirs(directory, exist_ok=True)

@@ -39,7 +39,10 @@ class HealthWriter:
     """Appender for one directory's health.jsonl / alerts.jsonl + the
     evidence_NNNN allocator. Creating one truncates the streams and removes
     stale evidence dirs (telemetry-sink discipline: a rebuilt run must not
-    inherit another run's alerts)."""
+    inherit another run's alerts). append_health/append_alert are the
+    streams' REGISTERED single writers: analysis Pass D's `race-sink-writer`
+    rule gates any second appender (monitors sharing one directory must
+    share one HealthWriter, as ServeSession's per-tenant monitors do)."""
 
     def __init__(self, directory: str):
         import json

@@ -155,7 +155,11 @@ class ChunkTimer:
     def end(self, sync=None) -> dict:
         """Close the chunk: `sync` forces a host copy of a small chunk output
         (its duration is the device wait); sample memory + jit caches, append
-        the row (and stream it to the sink)."""
+        the row (and stream it to the sink). `end(sync=...)` is also the
+        sync point Pass D's overlap audit recognizes: it CLOSES the
+        dispatch->sync window a donating chunk dispatch opened, so host
+        writes to the carry before this call are `race-window-mutation`
+        findings (analysis/race_audit.py)."""
         if self._t_begin is None:
             raise RuntimeError("ChunkTimer.end() without begin()")
         t_host = time.perf_counter()

@@ -449,6 +449,13 @@ class ServeSession:
         self._dispatch(*router.pack(self.chunk))
         while True:
             # ---- host window: runs while the dispatched chunk computes ----
+            # Everything until perf.end/finish_rounds below touches ONLY
+            # last chunk's pending host copies and the routers' own state --
+            # never the in-flight donated carry. That disjointness is a
+            # checked fact: Pass D's overlap write-set audit derives this
+            # window's writes (race_audit.overlap_write_sets) and gates any
+            # carry touch as `race-window-mutation`; the donation-poison
+            # sanitizer (--sanitize) re-proves it at runtime.
             e0 = time.perf_counter()
             if pending is not None:
                 self._export(*pending)

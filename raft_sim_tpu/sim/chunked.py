@@ -108,7 +108,12 @@ def run_chunked(
     so the steady state holds one fleet in HBM, not two. One consequence: a
     `state` captured inside `callback` is only valid until the callback
     returns -- copy (`jax.device_get`) anything a callback needs to keep, as
-    the checkpoint/apply-log consumers already do.
+    the checkpoint/apply-log consumers already do. This discipline is a
+    GATED fact: analysis Pass D's use-after-donate dataflow lint walks this
+    loop (rule `race-use-after-donate`, with `_own_copy` and
+    fetch-before-donate blessed), and `tools/check.py --race --dynamic` /
+    `driver run --sanitize` re-run it with donated buffers poisoned so any
+    violation raises at the access site (analysis/sanitizer.py).
 
     `perf` (an obs.ChunkTimer) records per-chunk runtime attribution to
     perf.jsonl: each chunk is synced to a host copy of its small metrics leaf

@@ -413,6 +413,11 @@ def run_chunked_telemetry(
     valid (one up-front copy, owned by the loop), each chunk's state is
     donated to the next, and a `state` captured inside `callback` is only
     valid until the callback returns -- `jax.device_get` anything it keeps.
+    Same for `chunk_hook`'s recorder and the callback's `records`: the
+    telemetry soak is walked by analysis Pass D's use-after-donate lint and
+    run under the donation-poison sanitizer (`tools/check.py --race
+    --dynamic`), so a hook that retains the live carry past its return is a
+    gated finding, not a latent chip-session bug.
     """
     batch = state.role.shape[0]
     ring_k = 0 if recorder is None else recorder.tick.shape[0]

@@ -1,0 +1,400 @@
+"""Pass D's own tests: every concurrency rule must fire on a seeded
+violation and stay silent on the blessed idioms, the tree must gate clean,
+and the runtime donation-poison sanitizer must (a) catch an injected
+use-after-donate naming the deleted buffer and (b) leave armed standing-loop
+runs bit-exact against plain runs on BOTH carry layouts.
+
+The negative seeds are the acceptance proof the pass is real: an injected
+use-after-donate (direct read, stale view, escaped closure), an in-window
+carry mutation, a double-consumed PRNG key, a second sink writer, and an
+unregistered donating entry point are each caught naming their rule --
+none relies on the race happening to lose at runtime.
+
+The static half is AST-only (no compiles); the sanitizer half runs tiny
+2-cluster sessions and shares programs with the rest of the tier-1 suite
+where shapes allow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from raft_sim_tpu.analysis import policy, race_audit, run, sanitizer
+from raft_sim_tpu.sim import chunked
+from raft_sim_tpu.types import init_batch
+from raft_sim_tpu.utils.config import RaftConfig
+
+TINY = RaftConfig(n_nodes=3, log_capacity=4, max_entries_per_rpc=1)
+
+SIM_PATH = "raft_sim_tpu/sim/fake_loop.py"
+KEY_PATH = "raft_sim_tpu/farm/fake_keys.py"
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------ use-after-donate lint
+
+
+def test_use_after_donate_direct_read():
+    src = (
+        "def loop(cfg, state, keys, export):\n"
+        "    out = _chunk_donate(cfg, state, keys, 4)\n"
+        "    export(state.term)\n"
+    )
+    got = race_audit.lint_source(src, SIM_PATH)
+    assert "race-use-after-donate" in rules_of(got)
+    assert any(f.line == 3 for f in got)
+
+
+def test_use_after_donate_stale_view():
+    src = (
+        "def loop(cfg, state, keys):\n"
+        "    view = state.log_val\n"
+        "    state, m = _chunk_donate(cfg, state, keys, 4)\n"
+        "    return view.sum()\n"
+    )
+    got = race_audit.lint_source(src, SIM_PATH)
+    assert "race-use-after-donate" in rules_of(got)
+
+
+def test_use_after_donate_escaped_closure():
+    src = (
+        "def loop(cfg, state, keys, sink):\n"
+        "    snap = lambda: state.term\n"
+        "    out = _chunk_donate(cfg, state, keys, 4)\n"
+        "    sink(snap)\n"
+    )
+    got = race_audit.lint_source(src, SIM_PATH)
+    assert "race-use-after-donate" in rules_of(got)
+
+
+def test_use_after_donate_next_iteration():
+    # The donated carry is NOT rebound; the loop's next iteration re-reads it.
+    src = (
+        "def loop(cfg, state, keys, n_ticks):\n"
+        "    done = 0\n"
+        "    while done < n_ticks:\n"
+        "        out = _chunk_donate(cfg, state, keys, 4)\n"
+        "        done += 4\n"
+        "    return state\n"
+    )
+    got = race_audit.lint_source(src, SIM_PATH)
+    assert "race-use-after-donate" in rules_of(got)
+
+
+def test_rebind_from_outputs_is_clean():
+    src = (
+        "def loop(cfg, state, keys):\n"
+        "    state = _own_copy(state)\n"
+        "    state, m = _chunk_donate(cfg, state, keys, 4)\n"
+        "    return state, m\n"
+    )
+    assert race_audit.lint_source(src, SIM_PATH) == []
+
+
+def test_unpack_via_raw_output_name_is_clean():
+    # telemetry's trace branch: kill via `out = ...`, rebind from `out`.
+    src = (
+        "def loop(cfg, state, keys, rec, flag):\n"
+        "    out = _chunk_t_donate(cfg, state, keys, rec, 4, 4, 0)\n"
+        "    if flag:\n"
+        "        state, m, recs, rec = out\n"
+        "    else:\n"
+        "        state, m, recs, rec = out\n"
+        "    return state, m\n"
+    )
+    assert race_audit.lint_source(src, SIM_PATH) == []
+
+
+def test_fetch_before_donate_is_clean():
+    src = (
+        "import jax\n"
+        "def loop(cfg, state, keys, export):\n"
+        "    snap = jax.device_get(state)\n"
+        "    state, m = _chunk_donate(cfg, state, keys, 4)\n"
+        "    export(snap)\n"
+        "    return state\n"
+    )
+    assert race_audit.lint_source(src, SIM_PATH) == []
+
+
+# ------------------------------------------------------- overlap window audit
+
+
+def test_window_mutation_fires():
+    src = (
+        "import jax\n"
+        "def loop(cfg, state, keys, perf):\n"
+        "    state, m = _chunk_donate(cfg, state, keys, 4)\n"
+        "    state = jax.tree.map(lambda x: x + 1, state)\n"
+        "    perf.end(sync=lambda: m.ticks)\n"
+    )
+    got = race_audit.lint_source(src, SIM_PATH)
+    assert "race-window-mutation" in rules_of(got)
+    assert any(f.line == 4 for f in got)
+
+
+def test_window_write_after_sync_is_clean():
+    src = (
+        "import jax\n"
+        "def loop(cfg, state, keys, perf):\n"
+        "    state, m = _chunk_donate(cfg, state, keys, 4)\n"
+        "    perf.end(sync=lambda: m.ticks)\n"
+        "    state = jax.tree.map(lambda x: x + 1, state)\n"
+    )
+    assert race_audit.lint_source(src, SIM_PATH) == []
+
+
+def test_disjoint_window_writes_are_clean():
+    # The serve-loop shape: in-window host work on NON-carry state, plus the
+    # blessed device-stream fetch (begin_rounds/finish_rounds).
+    src = (
+        "import numpy as np\n"
+        "def loop(cfg, state, keys, deltas, perf):\n"
+        "    state, m = _chunk_donate(cfg, state, keys, 4)\n"
+        "    futs = deltas.begin_rounds(state, 3)\n"
+        "    packed = np.zeros(4)\n"
+        "    rows = deltas.finish_rounds(futs)\n"
+        "    return state, rows, packed\n"
+    )
+    assert race_audit.lint_source(src, SIM_PATH) == []
+
+
+def test_overlap_write_sets_exclude_the_carry():
+    sets = race_audit.overlap_write_sets()
+    serve = sets.get("raft_sim_tpu/serve/loop.py::serve")
+    assert serve, f"serve() overlap write-set missing: {sorted(sets)}"
+    # The checked fact behind PR 11's overlapped loop: everything the host
+    # touches between dispatch and sync is disjoint from the in-flight carry.
+    assert "self.state" not in serve
+
+
+# ------------------------------------------------------- key-stream discipline
+
+
+def test_key_double_draw_fires():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    a = jax.random.bits(key)\n"
+        "    b = jax.random.bits(key)\n"
+        "    return a, b\n"
+    )
+    got = race_audit.lint_source(src, KEY_PATH)
+    assert rules_of(got) == ["race-key-reuse"]
+
+
+def test_key_double_split_fires():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    a, b = jax.random.split(key)\n"
+        "    c, d = jax.random.split(key)\n"
+        "    return a, b, c, d\n"
+    )
+    got = race_audit.lint_source(src, KEY_PATH)
+    assert rules_of(got) == ["race-key-reuse"]
+
+
+def test_key_draw_after_split_fires():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    subs = jax.random.split(key, 3)\n"
+        "    x = jax.random.uniform(key)\n"
+        "    return subs, x\n"
+    )
+    got = race_audit.lint_source(src, KEY_PATH)
+    assert rules_of(got) == ["race-key-reuse"]
+
+
+def test_key_distinct_streams_are_clean():
+    # The faults.py idiom: one split plus fold_ins with distinct salts.
+    src = (
+        "import jax\n"
+        "def f(key, now):\n"
+        "    wkey = jax.random.fold_in(key, now)\n"
+        "    k1, k2 = jax.random.split(wkey)\n"
+        "    tkey = jax.random.fold_in(wkey, 5)\n"
+        "    xkey = jax.random.fold_in(wkey, 7)\n"
+        "    return jax.random.bits(k1), jax.random.bits(k2), tkey, xkey\n"
+    )
+    assert race_audit.lint_source(src, KEY_PATH) == []
+
+
+def test_key_rebind_resets_ledger():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    key, sub = jax.random.split(key)\n"
+        "    key, sub2 = jax.random.split(key)\n"
+        "    return sub, sub2\n"
+    )
+    assert race_audit.lint_source(src, KEY_PATH) == []
+
+
+def test_key_rule_scoped_to_stochastic_dirs():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    a = jax.random.bits(key)\n"
+        "    b = jax.random.bits(key)\n"
+        "    return a, b\n"
+    )
+    assert race_audit.lint_source(src, "raft_sim_tpu/obs/fake.py") == []
+
+
+# ------------------------------------------------------- single-writer sinks
+
+
+def test_second_sink_writer_fires():
+    src = (
+        "def rogue(path, rows):\n"
+        "    with open(path + '/health.jsonl', 'a') as f:\n"
+        "        for r in rows:\n"
+        "            f.write(r)\n"
+    )
+    got = race_audit.lint_source(src, SIM_PATH)
+    assert "race-sink-writer" in rules_of(got)
+    assert "health.jsonl" in got[0].message
+
+
+def test_registered_sink_writer_is_clean():
+    src = (
+        "def append_health(path, rows):\n"
+        "    with open(path, 'a') as f:\n"
+        "        for r in rows:\n"
+        "            f.write(r)\n"
+    )
+    got = race_audit.lint_source(src, "raft_sim_tpu/health/monitor.py")
+    assert "race-sink-writer" not in rules_of(got)
+
+
+def test_stale_owner_registry_row_fires(monkeypatch):
+    monkeypatch.setitem(
+        race_audit.APPEND_OWNERS,
+        ("raft_sim_tpu/ghost.py", "append_ghost"), "ghost.jsonl",
+    )
+    got = race_audit.run_pass(run.package_root())
+    stale = [f for f in got if f.rule == "race-sink-writer"]
+    assert stale and "append_ghost" in stale[0].message
+
+
+# --------------------------------------------------- donation registry checks
+
+
+def test_unregistered_donation_fires():
+    src = (
+        "import functools, jax\n"
+        "@functools.partial(jax.jit, donate_argnums=(1,))\n"
+        "def _sneaky(cfg, state):\n"
+        "    return state\n"
+    )
+    got = race_audit.lint_source(src, SIM_PATH)
+    assert "race-unregistered-donation" in rules_of(got)
+
+
+def test_registry_entry_without_decorator_fires(monkeypatch):
+    ghost = policy.DonatingEntry(
+        "sim.chunked._ghost", "raft_sim_tpu/sim/chunked.py", "_ghost",
+        "state", "donated")
+    real = policy.donating_entry_points()
+    monkeypatch.setattr(policy, "donating_entry_points",
+                        lambda: real + (ghost,))
+    got = race_audit.run_pass(run.package_root())
+    bad = [f for f in got if f.rule == "race-unregistered-donation"]
+    assert bad and "_ghost" in bad[0].message
+
+
+def test_registry_covers_every_donating_decorator():
+    # The single-sourcing pin: Pass C's cost entries and Pass D's lint/
+    # sanitizer all read policy.donating_entry_points; every donated row must
+    # resolve a real (path, func, param) triple.
+    sigs = race_audit.donating_signatures()
+    donated = [e for e in policy.donating_entry_points()
+               if e.expected == "donated"]
+    assert sorted(sigs) == sorted(e.func for e in donated)
+    for e in donated:
+        idx, pname, label = sigs[e.func]
+        assert pname == e.donated_param and label == e.label
+
+
+def test_parse_error_is_a_finding():
+    got = race_audit.lint_source("def broken(:\n", SIM_PATH)
+    assert rules_of(got) == ["race-parse-error"]
+
+
+# ------------------------------------------------------------ tree gates clean
+
+
+def test_tree_gates_clean_race_pass():
+    from raft_sim_tpu.analysis import findings as F
+
+    found = race_audit.run_pass(run.package_root())
+    entries, problems = F.load_waivers(run.DEFAULT_WAIVERS)
+    assert not problems
+    F.apply_waivers(found, entries)
+    unwaived = [f for f in found if not f.waived]
+    assert unwaived == [], [
+        f"{f.rule} {f.location()}: {f.message}" for f in unwaived]
+
+
+# ----------------------------------------------------- the runtime sanitizer
+
+
+def _short_chunked(cfg, ticks=8, chunk=4):
+    state = init_batch(cfg, jax.random.key(0), 2)
+    keys = jax.random.split(jax.random.key(1), 2)
+    return chunked.run_chunked(cfg, state, keys, ticks, chunk=chunk)
+
+
+@pytest.mark.parametrize("layout", ["dense", "compact"])
+def test_sanitizer_armed_runs_bit_exact(layout):
+    cfg = TINY if layout == "dense" else dataclasses.replace(
+        TINY, compact_planes=True)
+    plain = _short_chunked(cfg)
+    with sanitizer.armed() as stats:
+        armed_out = _short_chunked(cfg)
+    assert stats["calls"], "sanitizer never covered the loop"
+    assert stats["poisoned"] + stats["pre_deleted"] > 0
+    assert sanitizer.mismatched_leaves(plain, armed_out) == []
+
+
+def test_sanitizer_catches_injected_use_after_donate():
+    state = init_batch(TINY, jax.random.key(0), 2)
+    keys = jax.random.split(jax.random.key(1), 2)
+    with sanitizer.armed():
+        carry = chunked._own_copy(state)
+        stale = carry  # the injected bug: a retained pre-dispatch alias
+        carry, m = chunked._chunk_donate(TINY, carry, keys, 4, None, 1)
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(stale.term)
+    # the caller's own state was never donated and stays readable
+    np.asarray(state.term)
+
+
+def test_sanitizer_restores_entry_points():
+    before = chunked._chunk_donate
+    with sanitizer.armed():
+        assert chunked._chunk_donate is not before
+        assert hasattr(chunked._chunk_donate, "_cache_size")
+    assert chunked._chunk_donate is before
+
+
+def test_dynamic_leg_gates_clean():
+    findings, info = sanitizer.run_dynamic()
+    assert findings == [], [f"{f.rule}: {f.message}" for f in findings]
+    assert set(info["loops"]) == {
+        "sim.chunked.run_chunked",
+        "sim.telemetry.run_chunked_telemetry",
+        "serve.loop.ServeSession.serve",
+    }
+    for loop_info in info["loops"].values():
+        assert loop_info["calls"], "a standing loop escaped coverage"
+    assert "farm" in info  # the no-donating-entry rationale is recorded

@@ -1,21 +1,27 @@
 """Static-analysis gate: run the raft_sim_tpu invariant auditor.
 
-Three passes (raft_sim_tpu/analysis): Pass A lowers the real step/scan
+Four passes (raft_sim_tpu/analysis): Pass A lowers the real step/scan
 programs per config tier and audits the jaxprs (dtype discipline,
 loop-invariant carry, recompile forks); Pass B lints the package source
 (traced branches, float literals) and cross-checks the types.py dtype
 comments and the checkpoint version pin against the live structures; Pass C
 prices the same lowered programs (scan-carry bytes/tick, live-set peak,
 entry-point donation, roofline at the pinned HBM rate) against the pins in
-tests/golden_cost_model.json. Lowering only -- no device execution, and the
-only XLA compiles are tiny-shape donation probes -- so the whole gate runs
-in well under a minute on CPU. CI runs it before the tier-1 tests.
+tests/golden_cost_model.json; Pass D audits host<->device concurrency
+(use-after-donate dataflow over the standing loops, overlap write-set
+disjointness, PRNG key-stream and single-writer sink discipline), with an
+optional runtime donation-poison leg (--dynamic). Lowering only -- no device
+execution, and the only XLA compiles are tiny-shape donation probes (plus
+the short sanitizer sessions when --dynamic is given) -- so the whole gate
+runs in well under a minute on CPU. CI runs it before the tier-1 tests.
 
     python tools/check.py --all                  # all passes, text report
     python tools/check.py --all --format=json    # machine-readable (CI artifact)
     python tools/check.py --ast                  # source + contract rules only
     python tools/check.py --jaxpr --configs config3,config5
     python tools/check.py --cost                 # Pass C (cost model) only
+    python tools/check.py --race                 # Pass D (concurrency) only
+    python tools/check.py --race --dynamic       # + runtime donation poison
     python tools/check.py --cost-diff            # pinned-vs-current cost table
     python tools/check.py --update-goldens       # re-pin tests/golden_cost_model.json
 
@@ -42,6 +48,18 @@ def main(argv=None) -> int:
     ap.add_argument("--ast", action="store_true", help="Pass B only (AST + contracts)")
     ap.add_argument("--jaxpr", action="store_true", help="Pass A only (jaxpr audit)")
     ap.add_argument("--cost", action="store_true", help="Pass C only (cost model)")
+    ap.add_argument(
+        "--race", action="store_true",
+        help="Pass D only (host<->device concurrency: use-after-donate "
+             "dataflow, overlap write-set, key-stream + sink-writer "
+             "discipline)",
+    )
+    ap.add_argument(
+        "--dynamic", action="store_true",
+        help="with the race pass: also run the runtime donation-poison "
+             "sanitizer (short sanitizer-armed standing-loop sessions, "
+             "bit-exactness pinned vs plain)",
+    )
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument(
         "--configs",
@@ -107,18 +125,24 @@ def main(argv=None) -> int:
         cost_model.diff_table(derived, golden)
         return 0
 
-    picked = args.ast or args.jaxpr or args.cost
+    picked = args.ast or args.jaxpr or args.cost or args.race
     do_ast = args.all or args.ast or not picked
     do_jaxpr = args.all or args.jaxpr or not picked
     do_cost = args.all or args.cost or not picked
+    do_race = args.all or args.race or not picked
     waivers_path = run.DEFAULT_WAIVERS
     if args.waivers:
         waivers_path = None if args.waivers == "none" else args.waivers
+    if args.dynamic and not do_race:
+        print("--dynamic needs the race pass (add --race or --all)",
+              file=sys.stderr)
+        return 2
 
     t0 = time.time()
     found, unused, problems, timings = run.run_all(
-        do_ast=do_ast, do_jaxpr=do_jaxpr, do_cost=do_cost,
-        config_names=config_names, waivers_path=waivers_path,
+        do_ast=do_ast, do_jaxpr=do_jaxpr, do_cost=do_cost, do_race=do_race,
+        do_dynamic=args.dynamic, config_names=config_names,
+        waivers_path=waivers_path,
     )
     elapsed = time.time() - t0
     unwaived = [f for f in found if not f.waived]
