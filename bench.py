@@ -69,6 +69,7 @@ MATRIX_TICKS = {
 SMOKE_BATCH = {
     "config2": 64,
     "config8": 64,
+    "config10": 64,
     "config9": 64,
     "config3": 512,
     "config3p": 512,
@@ -558,6 +559,11 @@ def measurement_pass(args) -> int:
     forces TimeoutNow transfers into nearly every joint-consensus window;
     both rows reconcile in the standing table, marked scenario/non-anchor.
 
+    Plus the durability pair on config10 (ISSUE 19): the fsync/WAL storage
+    plane on (the preset) vs structurally off (fsync_interval=0) -- prices
+    the durable-watermark carry, the fsync lattice draws, and the recovery
+    lanes; both rows reconcile in the standing table.
+
     On a CPU image the pass auto-shrinks to --smoke sizing (CPU rows can
     never anchor anyway -- reconciliation marks every row non-anchor);
     --full forces production sizing on any backend.
@@ -713,6 +719,31 @@ def measurement_pass(args) -> int:
         ),
     )
 
+    # Durability A/B (ISSUE 19): config10's fsync/WAL model vs the SAME
+    # preset with the storage plane structurally OFF (fsync_interval=0 and
+    # the dependent disk-fault knobs zeroed -- config.py rejects jitter/torn
+    # without the gate). The off arm is the zero-cost-when-off claim's priced
+    # half: its trajectory is bit-exact vs a pre-plane build (the gated legs
+    # are host constants), so the ratio prices the watermark carry + fsync
+    # lattice + recovery lanes end to end. Both arms reconcile in the
+    # standing table (CPU/smoke rows are non-anchor like every other row).
+    print("measurement A/B durability (config10)...", file=sys.stderr)
+    dur_cfg = PRESETS["config10"][0]
+    dur_batch, dur_ticks = _matrix_sizing("config10", smoke)
+    dur_on = bench(
+        dur_cfg, dur_batch, dur_ticks, args.repeats, config_name="config10",
+        smoke=smoke,
+    )
+    dur_off = bench(
+        _dc.replace(
+            dur_cfg, fsync_interval=0, fsync_jitter_prob=0.0,
+            torn_tail_prob=0.0, lost_suffix_span=1,
+        ),
+        dur_batch, dur_ticks, args.repeats, config_name="config10",
+        smoke=smoke,
+    )
+    dur_off["config_variant"] = "fsync_interval=0 (storage plane off)"
+
     mesh_scaling = _mesh_scaling_leg(args, smoke, backend)
 
     from raft_sim_tpu.obs import reconcile_matrix
@@ -725,6 +756,8 @@ def measurement_pass(args) -> int:
             **matrix,
             "config8": xj_plain,
             "config8/xfer-joint": xj_on,
+            "config10": dur_on,
+            "config10/durability-off": dur_off,
         }},
         default_backend=backend,
     )
@@ -755,6 +788,18 @@ def measurement_pass(args) -> int:
                  "(traffic_audit --serve has the static projection)"],
             ),
             "layout_dense_vs_compact": layout_ab,
+            "durability": _ab_pair(
+                "config10: storage plane off (fsync_interval=0) vs on "
+                "(fsync@3 + jitter/torn disk faults)",
+                dur_off, dur_on,
+                ["the off arm is config10 with the durable-storage gate "
+                 "structurally off: the dur watermark legs are carry "
+                 "passthroughs and the fsync/recovery lanes compile out "
+                 "(tests/test_storage.py pins the disabled-mode goldens "
+                 "byte-identical), so the ratio prices the plane itself",
+                 "off arm is not the preset's config: the row carries "
+                 "config_variant and can never anchor config10's roofline"],
+            ),
             "transfer_during_joint": _ab_pair(
                 "config8: homogeneous cadences (reconfig@97/transfer@61) vs "
                 "forced transfer-during-joint overlap (reconfig@24/"

@@ -124,6 +124,15 @@ FORK_PAIRS: tuple[tuple[str, dict], ...] = (
         "read_lease_ticks": 3, "read_interval": 5, "client_interval": 6,
         "clock_skew_prob": 0.2,
     }),
+    # Durable storage plane: the fsync cadence and every disk-fault
+    # probability are tuning knobs inside the structural gate
+    # (fsync_interval > 0) -- retiming flushes or reshaping the disk-fault
+    # lattice must never fork a compile. lost_suffix_span stays a traced
+    # randint bound (precedent: crash_down_ticks).
+    ("config10", {
+        "fsync_interval": 5, "fsync_jitter_prob": 0.35,
+        "torn_tail_prob": 0.15, "lost_suffix_span": 5, "crash_prob": 0.2,
+    }),
 )
 
 
@@ -677,9 +686,13 @@ NODE_COLLECTIVE_WHITELIST = frozenset({
 # here, the per-device mesh bytes priced by Pass C's mesh section, and the
 # node-sharded program's collective whitelist checked whenever a multi-device
 # mesh is live (check_node_collectives).
+# config10 adds the durable-storage family (raft_sim_tpu/storage: the
+# dur_len/dur_term/dur_vote watermark legs, the section-3.8 ack/grant gates,
+# crash recovery's truncate-and-rewind, and the fsync/torn-tail disk-fault
+# draws live).
 AUDIT_CONFIGS = (
     "config1", "config3", "config4", "config5", "config5c", "config6",
-    "config6r", "config7", "config7x", "config8", "config9",
+    "config6r", "config7", "config7x", "config8", "config9", "config10",
 )
 
 

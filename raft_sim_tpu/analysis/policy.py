@@ -256,6 +256,15 @@ def invariant_leaves(cfg: RaftConfig) -> set[str]:
         # The lease staleness anchor is dead weight on plain ReadIndex
         # configs too -- only the lease gate maintains it.
         inv |= {"read_fr"}
+    if not cfg.durable_storage:
+        # Durable storage plane off (raft_sim_tpu/storage): the watermark
+        # triple AND its RunMetrics lag accumulators are dead weight --
+        # scan's gated folds skip them when the kernel emits host-constant
+        # zeros.
+        inv |= {
+            "dur_len", "dur_term", "dur_vote",
+            "metric.fsync_lag_sum", "metric.fsync_lag_max",
+        }
     return inv
 
 

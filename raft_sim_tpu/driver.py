@@ -1408,7 +1408,8 @@ def main(argv=None) -> int:
                        help="comma list of fitness members hunted in "
                             "parallel over disjoint slices of the fleet "
                             "(farm/portfolio.py registry: scalar, coverage, "
-                            "multi_leader, commit_stall, read_staleness; "
+                            "multi_leader, commit_stall, read_staleness, "
+                            "durability; "
                             "default scalar,coverage)")
     sfarm.add_argument("--budget-gens", type=int, default=8,
                        help="generation budget; exhausting it hitless pins "

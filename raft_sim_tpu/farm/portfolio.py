@@ -81,6 +81,33 @@ def fit_read_staleness(records, metrics, novelty) -> np.ndarray:
     )
 
 
+def fit_durability(records, metrics, novelty) -> np.ndarray:
+    """Hunt committed-while-volatile exposure (raft_sim_tpu/storage): the
+    ack-before-fsync loss needs the commit frontier to ADVANCE while the
+    durable watermark lags (entries counted off volatile acks), then crash
+    churn to truncate and re-elect -- so weight each window's commit advance
+    by its fsync lag and add the churn that converts exposure into loss.
+    The pure-distress members anti-select this (a stalled cluster commits
+    nothing, so nothing it commits can be lost); zero exposure term when
+    the storage plane is off (the lag counters are gated device zeros)."""
+    mc = np.asarray(records.metrics.max_commit, np.float64)  # [B, W]
+    lag = np.asarray(records.metrics.fsync_lag_max, np.float64)  # [B, W]
+    if mc.shape[1] > 1:
+        adv = np.clip(np.diff(mc, axis=1), 0.0, None)
+        exposure = (adv * np.minimum(lag[:, 1:], 8.0)).sum(axis=1)
+    else:
+        exposure = np.zeros(mc.shape[0])
+    # Exposure DOMINANT, churn a tiebreak only: the distress terms the other
+    # members lean on anti-correlate with the traffic this exposure needs,
+    # and letting them lead walks the CE distribution into partition-dead
+    # clusters (churn without commits can never lose a committed entry).
+    return (
+        _viol(metrics)
+        + 5.0 * exposure
+        + search_mod.term_churn(metrics)
+    )
+
+
 # name -> (fitness fn, needs the trace-variant program for its signal).
 FITNESS = {
     "scalar": (fit_scalar, False),
@@ -88,6 +115,7 @@ FITNESS = {
     "multi_leader": (fit_multi_leader, False),
     "commit_stall": (fit_commit_stall, False),
     "read_staleness": (fit_read_staleness, False),
+    "durability": (fit_durability, False),
 }
 
 

@@ -36,7 +36,7 @@ EVIDENCE_SCHEMA = "health-evidence-v1"
 # cluster axis: the whole point is per-culprit forensics).
 EVIDENCE_WINDOW_FIELDS = (
     "window", "start", "ticks", "cluster", "violations", "cmds", "reads",
-    "lat_cnt", "lat_sum",
+    "lat_cnt", "lat_sum", "fsync_lag_sum", "fsync_lag_max",
 )
 
 
@@ -63,6 +63,8 @@ def window_rows_for(units: list[dict], clusters: list[int],
                 "lat_cnt": int(u["lat_cnt"][i]),
                 "lat_sum": int(u["lat_sum"][i]),
                 "lat_hist": [int(x) for x in np.asarray(u["lat_hist"][i])],
+                "fsync_lag_sum": int(u["fsync_lag_sum"][i]),
+                "fsync_lag_max": int(u["fsync_lag_max"][i]),
             })
     return rows
 

@@ -78,13 +78,13 @@ class HealthWriter:
 # loop's per-chunk deltas of these reconstruct window-unit counters.
 _ADDITIVE = (
     "violations", "total_cmds", "reads_served", "lat_sum", "lat_cnt",
-    "lat_hist", "read_hist",
+    "lat_hist", "read_hist", "fsync_lag_sum",
 )
 
 # The per-cluster arrays of a window unit (everything except start/ticks).
 UNIT_ARRAYS = (
     "violations", "leaderless", "cmds", "reads", "lat_sum", "lat_cnt",
-    "lat_hist", "read_hist",
+    "lat_hist", "read_hist", "fsync_lag_sum", "fsync_lag_max",
 )
 
 
@@ -189,6 +189,12 @@ class HealthMonitor:
             "lat_cnt": delta["lat_cnt"],
             "lat_hist": delta["lat_hist"],
             "read_hist": delta["read_hist"],
+            "fsync_lag_sum": delta["fsync_lag_sum"],
+            # RunMetrics.fsync_lag_max is a RUNNING max, so its delta is
+            # meaningless; the chunk window reports the cumulative max --
+            # conservative (a lag spike stays visible in every later chunk),
+            # matching this path's coarser leaderless semantics above.
+            "fsync_lag_max": np.asarray(metrics.fsync_lag_max).astype(np.int64),
         })
         self._cum = cum
         self._prev_done = int(done)

@@ -158,6 +158,26 @@ def compute_slis(spec: dict, units: list[dict], perf_rows: list[dict]) -> dict:
             errs[name] = 1.0 if bad else 0.0
             budgets[name] = 0.0
             percluster[name] = None
+        elif kind == "durability_lag":
+            # Storage-plane durability debt (window units fsync_lag_sum/max):
+            # the page signal is the WORST instantaneous per-node lag in the
+            # period vs the ceiling -- a disk stalled behind its log is a
+            # local fact, so the fleet mean would hide exactly the cluster
+            # that matters. The mean rides along as the trend readout.
+            lagmax = np.max([u["fsync_lag_max"] for u in units], axis=0)  # [B]
+            lag_sum = _sum_field(units, "fsync_lag_sum")
+            ticks = sum(int(u["ticks"]) for u in units)
+            ceiling = obj["max_lag"]
+            worst = int(lagmax.max())
+            slis[name] = {
+                "max_lag": worst,
+                "lag_per_tick": round(float(lag_sum.sum()) / ticks, 3)
+                if ticks else None,
+                "ceiling": ceiling,
+            }
+            errs[name] = 1.0 if (ceiling > 0 and worst > ceiling) else 0.0
+            budgets[name] = obj["budget"]
+            percluster[name] = lagmax.astype(np.float64)
         else:  # pragma: no cover - load_spec validates kinds
             raise ValueError(f"unknown sli kind {kind!r}")
     return {

@@ -36,6 +36,12 @@ Objective params by SLI kind (sli.py computes them):
     recompiles         (none)           budget 0: a steady-state chunk that
                                         recompiled is an instant page (the
                                         PR 8 watchdog, now an alert)
+    durability_lag     max_lag, budget  binary: any window where a node's
+                                        fsync lag (log entries not yet
+                                        durable, storage plane) exceeded the
+                                        ceiling burns `budget` (ceiling 0 =
+                                        disabled; all-zero lag when the
+                                        plane is off)
 
 Ratio objectives burn error budget `1 - target`; binary objectives carry an
 explicit `budget` (the tolerated trip fraction); budget-0 objectives page on
@@ -60,6 +66,7 @@ SLI_KINDS = (
     "safety",
     "device_wait_share",
     "recompiles",
+    "durability_lag",
 )
 
 # The default spec is deliberately quiet on a healthy run of ANY preset:
@@ -88,6 +95,7 @@ DEFAULT_SPEC = {
             "sli": "device_wait_share", "min_share": 0.0, "budget": 0.25,
         },
         "recompile": {"sli": "recompiles", "pending_evals": 0},
+        "durability": {"sli": "durability_lag", "max_lag": 0, "budget": 0.25},
     },
     # Google SRE Workbook ch.5 shape: a fast pair that pages on a steep burn
     # within ~2 eval periods, and a slow pair that catches a 1x bleed over a
@@ -142,10 +150,14 @@ def validate_spec(spec) -> list[str]:
             errors.append(f"objective {name!r}: threshold_ticks must be int >= 1")
         if kind in ("read_staleness",) and not _pos_int(obj.get("stale_after_ticks")):
             errors.append(f"objective {name!r}: stale_after_ticks must be int >= 1")
-        if kind in ("throughput", "device_wait_share"):
+        if kind in ("throughput", "device_wait_share", "durability_lag"):
             b = obj.get("budget")
             if not isinstance(b, (int, float)) or isinstance(b, bool) or not 0 < b <= 1:
                 errors.append(f"objective {name!r}: budget must be in (0, 1]")
+        if kind == "durability_lag":
+            ml = obj.get("max_lag")
+            if not isinstance(ml, int) or isinstance(ml, bool) or ml < 0:
+                errors.append(f"objective {name!r}: max_lag must be int >= 0")
         pe = obj.get("pending_evals")
         if pe is not None and (not isinstance(pe, int) or isinstance(pe, bool) or pe < 0):
             errors.append(f"objective {name!r}: pending_evals must be int >= 0")

@@ -40,19 +40,28 @@ SURVEY.md section 2.3 and deliberately NOT carried):
                                     (core.clj:48-67) writing the next tick's mailbox
   phase 9  invariants + metrics  <- absent in the reference; north-star requirement
   phase -1 restart wipe          <- the reference's process-death model (only committed
-                                    values are durable, log.clj:16-18); here restart is
-                                    spec-correct (persistent term/vote/log survive,
-                                    volatile state wiped), and down nodes are gated out
-                                    of delivery, timers, leadership, and commit
+                                    values are durable, log.clj:16-18); here restart
+                                    keeps the Raft persistent triple up to the DURABLE
+                                    watermarks (raft_sim_tpu/storage; with
+                                    cfg.durable_storage off the disk is perfect and the
+                                    full triple survives), wipes volatile state, and
+                                    down nodes are gated out of delivery, timers,
+                                    leadership, and commit
+  phase 7.5 fsync flush          <- absent in the reference (its file-backed atom has
+                                    no fsync discipline, log.clj:16-18): the durable
+                                    watermarks advance on the device-side fsync model's
+                                    completed flushes, and the section-3.8 gates hold
+                                    AE acks and vote grants to durable state
 
 Everything is written for ONE cluster (shapes [N], [N, N], [N, CAP]); `jax.vmap` lifts
 to [batch, ...] and `lax.scan` (sim/scan.py) rolls ticks.
 
 TRACE DELTA CONTRACT (raft_sim_tpu/trace, cfg.track_trace): the protocol
 trace plane derives discrete events from this kernel's state DELTAS --
-role, term, voted_for, commit_index, log_len, and (reconfiguration plane)
-cfg_epoch, log_cfg, xfer_to, read_idx -- outside the kernel (one extractor
-serves both kernels and any step_fn override; zero step lowerings added).
+role, term, voted_for, commit_index, log_len, dur_len, and (reconfiguration
+plane) cfg_epoch, log_cfg, xfer_to, read_idx -- outside the kernel (one
+extractor serves both kernels and any step_fn override; zero step lowerings
+added).
 Phase-order properties load-bearing for the whole-history checker, which must
 survive refactors: (1) a node that loses leadership and accepts entries in
 one tick changes `role` in the SAME tick as `log_len` (phase 1 adoption
@@ -65,7 +74,11 @@ per-node configuration (EV_CFG_APPLY/ROLLBACK replay after the role
 kinds); (4) a read slot dropped
 while its holder stays a same-term un-restarted leader was SERVED -- every
 cancel path changes role/term or sets `restarted` (phase 5.2's clear
-rules). See trace/events.py.
+rules); (5) a `dur_len` ADVANCE is always a completed flush (EV_FSYNC: the
+only writer besides recovery is phase 7.5, and recovery never raises it),
+and a `log_len` DROP on a `restarted` node is always the recovery
+truncation (EV_RECOVER_TRUNC: restarted nodes receive nothing, so the
+AE conflict truncation cannot co-occur on them). See trace/events.py.
 """
 
 from __future__ import annotations
@@ -76,6 +89,7 @@ import numpy as np
 
 from raft_sim_tpu.models import cfglog
 from raft_sim_tpu.ops import bitplane, log_ops
+from raft_sim_tpu.storage import plane as storage_plane
 from raft_sim_tpu.types import (
     CANDIDATE,
     FOLLOWER,
@@ -125,6 +139,7 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
     track = cfg.track_offer_ticks  # static: offer-tick plane + latency metric active
     rcf = cfg.reconfig  # static: joint-consensus membership plane active
     xfr = cfg.leader_transfer  # static: TimeoutNow transfer plane active
+    dur = cfg.durable_storage  # static: fsync/WAL durability plane active
     rdx = cfg.read_index  # static: ReadIndex read traffic class active
     rdl = cfg.read_lease  # static: lease-based reads (thesis 6.4.1) active
     ids = jnp.arange(n, dtype=jnp.int32)
@@ -140,8 +155,14 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
     # durable applied prefix -- everything else is volatile and wiped (Raft fig. 2
     # state table). The reference instead persists only committed values
     # (log.clj:16-18), so its restarted process forgets term/vote -- bug 2.3.12,
-    # deliberately not carried. Wiping commitIndex here (before `old` is captured
-    # for phase 9) keeps the monotonic-commit invariant meaningful.
+    # deliberately not carried. HOW MUCH of the triple survives is the durable
+    # storage plane's gate (raft_sim_tpu/storage, cfg.durable_storage): with the
+    # gate off the disk is perfect and the full triple survives instantly; with
+    # it on, the recovery block below rewinds term/vote to the durable snapshot
+    # and truncates the log tail the disk never confirmed (dissertation section
+    # 3.8 -- the failure class the plane exists to express). Wiping commitIndex
+    # here (before `old` is captured for phase 9) keeps the monotonic-commit
+    # invariant meaningful.
     rs = inp.restarted
     s = s._replace(
         role=jnp.where(rs, FOLLOWER, s.role),
@@ -154,6 +175,18 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
+    if dur:
+        # Crash recovery (storage/plane.recover): the disk holds the
+        # fsynced prefix for sure plus whatever un-fsynced tail the
+        # in-flight writes reached, minus the torn tail the recovery
+        # checksum rejects (inp.torn_drop, drawn every tick, consumed only
+        # here); term/votedFor rewind to the durable snapshot.
+        r_term, r_vote, r_len = storage_plane.recover(
+            cfg, rs, inp.torn_drop,
+            s.dur_len, s.dur_term, s.dur_vote,
+            s.term, s.voted_for, s.log_len,
+        )
+        s = s._replace(term=r_term, voted_for=r_vote, log_len=r_len)
     if cfg.pre_vote or rdl or cfg.reconfig:
         # A restarted node remembers no leader contact: "quiet" immediately
         # (pre-votes grantable, and -- under the lease or log-carried-config
@@ -436,6 +469,13 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
         any_mismatch, appended_len, jnp.maximum(s.log_len, appended_len)
     )
     log_len = jnp.where(ae_ok, new_len, s.log_len)
+    if dur:
+        # Truncation makes the removed suffix non-durable AS LOG CONTENT: the
+        # watermark clamps down with the log (the bytes may sit on disk, but
+        # the durable-log contract is about the entries the recovery would
+        # reconstruct, and those are gone). Appends do NOT advance it -- only
+        # a completed flush does (phase 7.5).
+        dur_mid = jnp.minimum(s.dur_len, log_len)
     wmask = ae_ok[:, None] & in_ent
     if comp:
         log_term_arr = log_ops.write_window_r(s.log_term, prev_i, ent_term_in, wmask)
@@ -670,7 +710,15 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
 
     # ---- phase 5: leader commit advancement (absent in reference, bug 2.3.8) ------
     is_leader = role == LEADER
-    match_with_self = jnp.where(eye, log_len[:, None], match_index)  # [N, N]
+    if dur and cfg.durable_acks:
+        # Section-3.8 gate, leader self-match side: the leader's own log
+        # counts toward commit only up to ITS durable watermark -- it is a
+        # replica like any other, and commit means "on stable storage at a
+        # quorum". Uses the pre-flush watermark (this tick's flush lands in
+        # phase 7.5): one tick of lag, never a lie.
+        match_with_self = jnp.where(eye, dur_mid[:, None], match_index)
+    else:
+        match_with_self = jnp.where(eye, log_len[:, None], match_index)  # [N, N]
     if rcf:
         # Configuration-masked quorum match under EACH LEADER's OWN derived
         # configuration: the largest replicated index v such that a majority
@@ -1181,6 +1229,42 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
         votes = jnp.where(start_election[:, None], eye_p, votes)
         deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
 
+    # ---- phase 7.5: fsync flush + section-3.8 durability gates -------------------
+    # The device-side fsync model (raft_sim_tpu/storage): a completed flush
+    # (inp.fsync_fire -- the cadence tick minus the per-node latency-jitter
+    # stall, sim/faults._storage_draws; a dead disk never flushes) snaps the
+    # durable snapshot to the node's FINAL live state this tick -- the
+    # post-injection log length and the post-election term/vote. Between
+    # flushes the watermark carries (clamped by truncation, dur_mid above).
+    if dur:
+        fs_fire = inp.fsync_fire & inp.alive
+        dur2_len, dur2_term, dur2_vote = storage_plane.flush(
+            fs_fire, dur_mid, s.dur_term, s.dur_vote, log_len, term, voted_for
+        )
+        if cfg.durable_acks:
+            # Gate 1 -- AE acks: the acked match index never exceeds the
+            # durable watermark. A follower behind a slow disk acks LESS
+            # than it appended (the leader's match/next simply lag; the
+            # idempotent consistency check absorbs the re-sends), so
+            # replication STALLS behind the disk instead of lying about it.
+            # The nack catch-up hint stays volatile: it is an optimization
+            # target, never counted toward commit.
+            out_a_match = jnp.minimum(
+                out_a_match.astype(jnp.int32), dur2_len
+            ).astype(idt)
+            # Gate 2 -- vote grants: a grant is EXPOSED only once the
+            # (term, votedFor) pair it commits to is durable. covered0 vs
+            # covered2 splits "already exposed on an earlier tick" from
+            # "this tick's flush just made it durable": the latter emits a
+            # LATE vote-completion response below (phase 8) when the grant
+            # tick itself could not -- the array form of "respond after the
+            # fsync returns". A grant whose flush never lands before the
+            # candidate gives up is simply lost (like a dropped response).
+            covered0 = storage_plane.covered(s.dur_term, s.dur_vote, term, voted_for)
+            covered2 = storage_plane.covered(dur2_term, dur2_vote, term, voted_for)
+            grant_to = jnp.where(covered2, voted_for, NIL).astype(node_dtype(cfg))
+            late_grant = covered2 & ~covered0 & ~granted_any
+
     # ---- phase 8: outbox ---------------------------------------------------------
     send_append = win | heartbeat  # fresh leaders heartbeat immediately (core.clj:137-138)
     if comp:
@@ -1309,6 +1393,20 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
         out_pv_grant = bitplane.pack(pv_grant, axis=1)  # [cand, W(bit=voter)]
     else:
         out_pv_grant = mb.pv_grant  # zeros, loop-invariant carry component
+    if dur and cfg.durable_acks:
+        # Late vote-completion response (phase 7.5 gate 2): the flush that
+        # just made this voter's grant durable emits the RESP_VOTE edge the
+        # grant tick withheld -- toward the recorded candidate, only where
+        # the edge carries no response already (a candidate that won
+        # meanwhile is heartbeating us; its AE response outranks the vote it
+        # no longer needs). v_to already names the candidate via covered2.
+        vfc = jnp.clip(voted_for, 0, n - 1)
+        late_edge = (ids[:, None] == vfc[None, :]) & late_grant[None, :]
+        out_resp_kind = jnp.where(
+            late_edge & (out_resp_kind == 0),
+            jnp.int8(RESP_VOTE),
+            out_resp_kind,
+        )
     pterm = (
         log_ops.term_at_r(log_term_arr, base, bterm, ws)
         if comp
@@ -1411,6 +1509,9 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
         log_val=log_val_arr,
         log_tick=log_tick_arr,
         log_len=log_len,
+        dur_len=dur2_len if dur else s.dur_len,
+        dur_term=dur2_term if dur else s.dur_term,
+        dur_vote=dur2_vote if dur else s.dur_vote,
         clock=clock,
         deadline=deadline,
         heard_clock=heard,
@@ -1435,10 +1536,21 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
         mailbox=new_mb,
     )
 
+    # Durability-lag reductions (StepInfo; host-constant zeros when the plane
+    # is off -- same zero-cost contract as the read metrics above).
+    if dur:
+        lag = log_len - dur2_len  # [N] >= 0 (flush snaps to log_len)
+        fsync_lag_sum = jnp.sum(lag).astype(jnp.int32)
+        fsync_lag_max = jnp.max(lag).astype(jnp.int32)
+    else:
+        fsync_lag_sum = np.int32(0)
+        fsync_lag_max = np.int32(0)
+
     info = _step_info(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
         reads_served, read_lat_sum, read_hist, viol_read_stale,
+        fsync_lag_sum, fsync_lag_max,
     )
     return new_state, info
 
@@ -1461,6 +1573,8 @@ def _step_info(
     read_lat_sum: jax.Array,
     read_hist: jax.Array,
     viol_read_stale: jax.Array,
+    fsync_lag_sum: jax.Array,
+    fsync_lag_max: jax.Array,
 ) -> StepInfo:
     """Phase 9: on-device safety invariants + observability reductions (per cluster)."""
     n = cfg.n_nodes
@@ -1591,4 +1705,6 @@ def _step_info(
         read_lat_sum=read_lat_sum,
         read_hist=read_hist,
         viol_read_stale=viol_read_stale,
+        fsync_lag_sum=fsync_lag_sum,
+        fsync_lag_max=fsync_lag_max,
     )

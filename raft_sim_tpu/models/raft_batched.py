@@ -25,6 +25,7 @@ from jax import lax
 
 from raft_sim_tpu.models import cfglog
 from raft_sim_tpu.ops import bitplane, log_ops
+from raft_sim_tpu.storage import plane as storage_plane
 from raft_sim_tpu.types import (
     CANDIDATE,
     FOLLOWER,
@@ -189,6 +190,7 @@ def _step_b(
     xfr = cfg.leader_transfer  # static: TimeoutNow transfer plane active
     rdx = cfg.read_index  # static: ReadIndex read traffic class active
     rdl = cfg.read_lease  # static: lease-based reads (thesis 6.4.1) active
+    dur = cfg.durable_storage  # static: fsync/WAL durability plane active
     b = s.role.shape[-1]
     # All iota-style constants are built at their final rank (log_ops.iota): Mosaic
     # cannot lower unit-dim-appending reshapes, and this module doubles as the
@@ -213,7 +215,10 @@ def _step_b(
         # carried reconfig, transfer coups, ReadIndex/lease quorums, the O(N^2
         # CAP) log-matching pairs) are excluded -- parallel/nodeshard.py raises
         # a friendly error before tracing ever gets here.
-        assert not (rcf or xfr or rdx or rdl or cfg.client_redirect or cfg.check_log_matching)
+        assert not (
+            rcf or xfr or rdx or rdl or dur
+            or cfg.client_redirect or cfg.check_log_matching
+        )
         nl, npd = sh.nl, sh.n_pad
         ids2 = sh.row0 + iota((nl, 1), 0)  # [nl, 1] GLOBAL ids of local rows
         peer3 = iota((nl, npd, 1), 1)  # [nl, n_pad, 1] -> peer id
@@ -252,6 +257,15 @@ def _step_b(
         commit_chk=jnp.where(rs, s.base_chk, s.commit_chk),
         deadline=jnp.where(rs, s.clock + inp.timeout_draw, s.deadline),
     )
+    if dur:
+        # Crash recovery (raft.py phase -1; storage/plane.recover is
+        # elementwise, so the [N, B] orientation broadcasts through).
+        r_term, r_vote, r_len = storage_plane.recover(
+            cfg, rs, inp.torn_drop,
+            s.dur_len, s.dur_term, s.dur_vote,
+            s.term, s.voted_for, s.log_len,
+        )
+        s = s._replace(term=r_term, voted_for=r_vote, log_len=r_len)
     if cfg.pre_vote or rdl or rcf:
         # A restarted node remembers no leader contact: "quiet" immediately
         # (pre-votes grantable, and -- under the lease or log-carried-config
@@ -507,6 +521,9 @@ def _step_b(
     any_mismatch = jnp.any(mismatch, axis=1)  # [N, B]
     new_len = jnp.where(any_mismatch, appended_len, jnp.maximum(s.log_len, appended_len))
     log_len = jnp.where(ae_ok, new_len, s.log_len)
+    if dur:
+        # Durable watermark after the AE conflict truncation (raft.py phase 3).
+        dur_mid = jnp.minimum(s.dur_len, log_len)
     if comp:
         log_term_arr = log_ops.write_window_rb(
             s.log_term, prev_i, ent_term_in, ae_ok, lo, n_acc
@@ -709,7 +726,13 @@ def _step_b(
 
     # ---- phase 5: leader commit advancement --------------------------------------
     is_leader = role == LEADER
-    match_with_self = jnp.where(eye_ls, len_i[:, None, :], match_index)  # [N, N, B]
+    if dur and cfg.durable_acks:
+        # A leader's own vote for a replication quorum is its DURABLE length
+        # (raft.py phase 5: the leader's disk is a follower too).
+        dmi = dur_mid.astype(len_i.dtype)
+        match_with_self = jnp.where(eye_ls, dmi[:, None, :], match_index)
+    else:
+        match_with_self = jnp.where(eye_ls, len_i[:, None, :], match_index)  # [N, N, B]
     # quorum-th largest match without a sort (TPU sorts along a non-minor axis are
     # slow). Two equivalent counting forms; pick per static shapes:
     #   cap < n  (config5: N=51, CAP=16): match values are bounded by CAP, so count
@@ -1127,6 +1150,28 @@ def _step_b(
         votes = jnp.where(start_election[:, None, :], eye_p3, votes)
         deadline = jnp.where(start_election, clock + inp.timeout_draw, deadline)
 
+    # ---- phase 7.5: fsync flush + durability gates (raft.py phase 7.5) -----------
+    if dur:
+        fs_fire = inp.fsync_fire & inp.alive  # dead disks never flush
+        dur2_len, dur2_term, dur2_vote = storage_plane.flush(
+            fs_fire, dur_mid, s.dur_term, s.dur_vote, log_len, term, voted_for
+        )
+        if cfg.durable_acks:
+            # Gate 1 (ack durability): AE acks reflect only the fsynced
+            # prefix (raft.py phase 7.5).
+            out_a_match = jnp.minimum(
+                out_a_match.astype(jnp.int32), dur2_len
+            ).astype(idt)
+            # Gate 2 (vote durability): a grant is exposed only once the
+            # durable snapshot covers it; the covering flush emits the
+            # withheld response (late_grant -> outbox overlay below).
+            covered0 = storage_plane.covered(s.dur_term, s.dur_vote, term, voted_for)
+            covered2 = storage_plane.covered(dur2_term, dur2_vote, term, voted_for)
+            grant_to = jnp.where(covered2, voted_for, NIL).astype(
+                node_dtype(cfg)
+            )
+            late_grant = covered2 & ~covered0 & ~granted_any
+
     # ---- phase 8: outbox ---------------------------------------------------------
     send_append = win | heartbeat
     if comp:
@@ -1251,6 +1296,16 @@ def _step_b(
             out_pv_grant = bitplane.pack(jnp.swapaxes(pv_grant, 0, 1), axis=1)
     else:
         out_pv_grant = mb.pv_grant  # zeros, loop-invariant carry component
+    if dur and cfg.durable_acks:
+        # Late vote-completion response (phase 7.5 gate 2; raft.py for the
+        # full argument and the AE-response collision guard).
+        vfc = jnp.clip(voted_for, 0, n - 1)
+        late_edge = (ids2[:, :, None] == vfc[None, :, :]) & late_grant[None, :, :]
+        out_resp_kind = jnp.where(
+            late_edge & (out_resp_kind == 0),
+            jnp.int8(RESP_VOTE),
+            out_resp_kind,
+        )
     if comp:
         pterm = log_ops.term_at_rb(log_term_arr, base, bterm, ws)
     else:
@@ -1354,6 +1409,9 @@ def _step_b(
         log_val=log_val_arr,
         log_tick=log_tick_arr,
         log_len=log_len,
+        dur_len=dur2_len if dur else s.dur_len,
+        dur_term=dur2_term if dur else s.dur_term,
+        dur_vote=dur2_vote if dur else s.dur_vote,
         clock=clock,
         deadline=deadline,
         heard_clock=heard,
@@ -1378,10 +1436,20 @@ def _step_b(
         mailbox=new_mb,
     )
 
+    # Durability-lag reductions (host-constant zeros when the plane is off).
+    if dur:
+        lag = log_len - dur2_len  # [N, B] >= 0 (flush snaps to log_len)
+        fsync_lag_sum = jnp.sum(lag, axis=0).astype(jnp.int32)
+        fsync_lag_max = jnp.max(lag, axis=0).astype(jnp.int32)
+    else:
+        fsync_lag_sum = np.zeros((b,), np.int32)
+        fsync_lag_max = np.zeros((b,), np.int32)
+
     info = _step_info_b(
         cfg, s, new_state, req_in, resp_in, inp.alive, cmds_cnt, chk_ok,
         lat_sum, lat_cnt, lat_hist, lat_excluded, noop_blocked,
-        reads_served, read_lat_sum, read_hist, viol_read_stale, sh,
+        reads_served, read_lat_sum, read_hist, viol_read_stale,
+        fsync_lag_sum, fsync_lag_max, sh,
     )
     return new_state, info
 
@@ -1404,6 +1472,8 @@ def _step_info_b(
     read_lat_sum: jax.Array,
     read_hist: jax.Array,
     viol_read_stale: jax.Array,
+    fsync_lag_sum: jax.Array,
+    fsync_lag_max: jax.Array,
     sh: NodeShardCtx | None = None,
 ) -> StepInfo:
     """Batched phase 9; see raft._step_info. All outputs [B]."""
@@ -1567,4 +1637,6 @@ def _step_info_b(
         read_lat_sum=read_lat_sum,
         read_hist=read_hist,
         viol_read_stale=viol_read_stale,
+        fsync_lag_sum=fsync_lag_sum,
+        fsync_lag_max=fsync_lag_max,
     )

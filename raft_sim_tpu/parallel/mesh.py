@@ -270,6 +270,17 @@ class FleetSummary(NamedTuple):
     read_p50: float | None
     read_p95: float | None
     read_p99: float | None
+    # Durable storage plane (RunMetrics.fsync_lag_sum/fsync_lag_max; zeros
+    # unless cfg.durable_storage): how far disks trail the logs. The
+    # percentiles are over PER-CLUSTER mean lag (lag_sum / ticks, i.e.
+    # node-summed entries-behind per tick) -- the fleet's "typical cluster"
+    # durability debt -- and fsync_lag_max is the worst instantaneous
+    # per-node lag seen anywhere (the burn plane's page signal feeds on the
+    # per-window form of the same counters, health/spec.py durability_lag).
+    fsync_lag_total: int
+    fsync_lag_max: int
+    fsync_lag_p50: float | None
+    fsync_lag_p95: float | None
 
 
 def gather_metrics(metrics):
@@ -371,5 +382,29 @@ def summarize(metrics) -> FleetSummary:
         noop_blocked=int(np.sum(m.noop_blocked, dtype=np.int64)),
         lm_skipped_pairs=int(np.sum(m.lm_skipped_pairs, dtype=np.int64)),
         multi_leader=int(np.sum(m.multi_leader, dtype=np.int64)),
+        **_fsync_lag_rollup(m),
         **_latency_rollup(m),
     )
+
+
+def _fsync_lag_rollup(m) -> dict:
+    """Fleet durability-lag readouts (FleetSummary docstring). Per-cluster
+    mean lag = lag_sum / ticks (node-summed entries-behind per tick); the
+    percentiles are None when no tick ran. All-zero with the storage plane
+    off -- the gated metric legs never accumulate."""
+    import numpy as np
+
+    ticks = np.asarray(m.ticks, dtype=np.int64)
+    ran = ticks > 0
+    if np.any(ran):
+        mean_lag = np.asarray(m.fsync_lag_sum, np.int64)[ran] / ticks[ran]
+        p50 = float(np.percentile(mean_lag, 50))
+        p95 = float(np.percentile(mean_lag, 95))
+    else:
+        p50 = p95 = None
+    return {
+        "fsync_lag_total": int(np.sum(m.fsync_lag_sum, dtype=np.int64)),
+        "fsync_lag_max": int(np.max(m.fsync_lag_max)),
+        "fsync_lag_p50": p50,
+        "fsync_lag_p95": p95,
+    }

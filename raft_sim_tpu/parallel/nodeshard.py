@@ -100,6 +100,9 @@ _STATE_PAD = {
     "log_val": ((0,), 0),
     "log_tick": ((0,), 0),
     "log_len": ((0,), 0),
+    "dur_len": ((0,), 0),
+    "dur_term": ((0,), 1),
+    "dur_vote": ((0,), NIL),
     "clock": ((0,), 0),
     "deadline": ((0,), 0),  # expiry is gated on alive: any value is inert
     "heard_clock": ((0,), lambda cfg: -cfg.election_min_ticks),
@@ -166,6 +169,8 @@ _INPUT_PAD = {
     "reconfig_cmd": ((), 0),
     "transfer_cmd": ((), 0),
     "read_cmd": ((), 0),
+    "fsync_fire": ((0,), False),
+    "torn_drop": ((0,), 0),
 }
 
 # A new state/mailbox/input leg without a pad rule would silently corrupt the
@@ -270,6 +275,7 @@ def check_shardable(cfg: RaftConfig, n_shards: int) -> int:
             ("leader_transfer", cfg.leader_transfer),
             ("read_index", cfg.read_index),
             ("read_lease", cfg.read_lease),
+            ("durable_storage", cfg.durable_storage),
             ("client_redirect", cfg.client_redirect),
             ("check_log_matching", cfg.check_log_matching),
         ]

@@ -54,6 +54,15 @@ class ScenarioGenome(NamedTuple):
     reconfig_interval: jax.Array  # [S] int32: membership-toggle cadence (0 = none)
     transfer_interval: jax.Array  # [S] int32: leadership-transfer cadence (0 = none)
     read_interval: jax.Array  # [S] int32: ReadIndex offer cadence (0 = none)
+    # Disk-fault axes (raft_sim_tpu/storage): fsync cadence / latency-jitter
+    # stalls / torn-tail truncation on restart. Tuning knobs over the durable
+    # storage plane -- the STRUCTURAL gate stays on RaftConfig
+    # (fsync_interval > 0), the genome retimes flushes and reshapes the crash
+    # lattice within it (validate() enforces the pairing).
+    fsync_interval: jax.Array  # [S] int32: fsync cadence ticks (0 = plane off)
+    fsync_jitter: jax.Array  # [S] uint32: per-node flush-stall threshold
+    torn: jax.Array  # [S] uint32: torn-tail-on-restart threshold
+    torn_span: jax.Array  # [S] int32: max extra entries a torn tail rejects
 
 
 # The threshold-encoded (uint32) fields; everything else is int32. The ONE
@@ -61,7 +70,7 @@ class ScenarioGenome(NamedTuple):
 # analyzer's genome avals (analysis/policy.scenario_genome_leaves,
 # jaxpr_audit._genome_avals) all derive from it, so a field add/rename cannot
 # silently fork the audited program's dtypes from the real one's.
-U32_FIELDS = frozenset({"drop", "part", "crash", "skew"})
+U32_FIELDS = frozenset({"drop", "part", "crash", "skew", "fsync_jitter", "torn"})
 
 
 def leaf_dtype(field: str):
@@ -81,6 +90,10 @@ def segment(
     reconfig_interval: int = 0,
     transfer_interval: int = 0,
     read_interval: int = 0,
+    fsync_interval: int = 0,
+    fsync_jitter_prob: float = 0.0,
+    torn_tail_prob: float = 0.0,
+    lost_suffix_span: int = 1,
 ) -> dict:
     """One segment's parameters in HUMAN units (probabilities as floats),
     encoded to the genome's integer fields. The declarative scenario-file
@@ -96,6 +109,10 @@ def segment(
         "reconfig_interval": int(reconfig_interval),
         "transfer_interval": int(transfer_interval),
         "read_interval": int(read_interval),
+        "fsync_interval": int(fsync_interval),
+        "fsync_jitter": p_to_u32(fsync_jitter_prob),
+        "torn": p_to_u32(torn_tail_prob),
+        "torn_span": int(lost_suffix_span),
     }
 
 
@@ -134,6 +151,10 @@ def from_config(cfg: RaftConfig) -> ScenarioGenome:
             reconfig_interval=cfg.reconfig_interval,
             transfer_interval=cfg.transfer_interval,
             read_interval=cfg.read_interval,
+            fsync_interval=cfg.fsync_interval,
+            fsync_jitter_prob=cfg.fsync_jitter_prob,
+            torn_tail_prob=cfg.torn_tail_prob,
+            lost_suffix_span=cfg.lost_suffix_span,
         )
     ])
 
@@ -198,6 +219,33 @@ def validate(cfg: RaftConfig, genome: ScenarioGenome) -> None:
                 f"set a nonzero cfg.{knob} as the base cadence the genome "
                 "tunes (docs/PROTOCOL.md)"
             )
+    fi = np.asarray(genome.fsync_interval)
+    if (fi < 0).any():
+        raise ValueError("fsync_interval must be >= 0 (0 disables fsync)")
+    if (fi > 0).any() and not cfg.durable_storage:
+        raise ValueError(
+            "genome drives fsync_interval but the config's fsync_interval is "
+            "0: the durable storage plane is a STRUCTURAL gate (the durable "
+            "watermark carry legs and section-3.8 ack/grant gates only "
+            "compile in when the config enables it) -- set a nonzero "
+            "cfg.fsync_interval as the base cadence the genome tunes "
+            "(raft_sim_tpu/storage)"
+        )
+    for field in ("fsync_jitter", "torn"):
+        v = np.asarray(getattr(genome, field))
+        if (v > 0).any() and not cfg.durable_storage:
+            raise ValueError(
+                f"genome sets {field} but the config's fsync_interval is 0: "
+                "disk faults perturb the durable storage plane -- set a "
+                "nonzero cfg.fsync_interval as the base cadence they perturb"
+            )
+    ts = np.asarray(genome.torn_span)
+    if (ts < 1).any() or (ts > cfg.log_capacity).any():
+        raise ValueError(
+            f"torn_span must lie in [1, log_capacity={cfg.log_capacity}] "
+            "(the torn-tail draw rejects 1..span extra entries; see "
+            "faults._storage_draws)"
+        )
 
 
 def decode(genome: ScenarioGenome) -> list[dict]:
@@ -217,6 +265,10 @@ def decode(genome: ScenarioGenome) -> list[dict]:
             "reconfig_interval": int(g["reconfig_interval"][i]),
             "transfer_interval": int(g["transfer_interval"][i]),
             "read_interval": int(g["read_interval"][i]),
+            "fsync_interval": int(g["fsync_interval"][i]),
+            "fsync_jitter_prob": round(float(g["fsync_jitter"][i]) / U32_SPAN, 9),
+            "torn_tail_prob": round(float(g["torn"][i]) / U32_SPAN, 9),
+            "lost_suffix_span": int(g["torn_span"][i]),
         }
         for i in range(s_count)
     ]
@@ -228,26 +280,35 @@ def to_raw(genome: ScenarioGenome) -> dict:
     return {f: np.asarray(getattr(genome, f)).tolist() for f in genome._fields}
 
 
-# The only fields from_raw may backfill when absent: pre-v22 artifacts
-# predate the reconfiguration-plane cadences, and an absent cadence decodes
-# as the all-zero (disabled) stream -- which reproduces the old trajectory
-# exactly (disabled cadences draw nothing). CORE fields stay strict: a
-# missing one is artifact corruption and must raise, not silently replay a
-# different scenario.
-_OPTIONAL_FIELDS = frozenset(
-    {"reconfig_interval", "transfer_interval", "read_interval"}
-)
+# The only fields from_raw may backfill when absent, with the value that
+# reproduces the old trajectory exactly: pre-v22 artifacts predate the
+# reconfiguration-plane cadences and pre-v25 artifacts the disk-fault axes;
+# an absent cadence/threshold decodes as its disabled value (0 -- disabled
+# streams draw nothing the kernels consume) and an absent torn_span as the
+# no-op span floor 1 (validate() requires span >= 1; with the torn threshold
+# 0 it is never consumed). CORE fields stay strict: a missing one is artifact
+# corruption and must raise, not silently replay a different scenario.
+_OPTIONAL_FIELDS = {
+    "reconfig_interval": 0,
+    "transfer_interval": 0,
+    "read_interval": 0,
+    "fsync_interval": 0,
+    "fsync_jitter": 0,
+    "torn": 0,
+    "torn_span": 1,
+}
 
 
 def from_raw(raw: dict) -> ScenarioGenome:
     """Inverse of to_raw: rebuild the exact genome from artifact integers
-    (see _OPTIONAL_FIELDS for the pre-v22 compatibility rule)."""
+    (see _OPTIONAL_FIELDS for the pre-v22/pre-v25 compatibility rule)."""
     shape = np.asarray(raw["drop"]).shape
-    zeros = np.zeros(shape, dtype=int).tolist()
     return ScenarioGenome(
         **{
             f: jnp.asarray(
-                raw.get(f, zeros) if f in _OPTIONAL_FIELDS else raw[f],
+                raw.get(f, np.full(shape, _OPTIONAL_FIELDS[f], dtype=int).tolist())
+                if f in _OPTIONAL_FIELDS
+                else raw[f],
                 leaf_dtype(f),
             )
             for f in ScenarioGenome._fields

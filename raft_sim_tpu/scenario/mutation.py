@@ -117,6 +117,39 @@ class LeaseSkewConfig(RaftConfig):
         return False
 
 
+class AckBeforeFsyncConfig(RaftConfig):
+    """Acks reflect volatile state (cfg.durable_acks False): an
+    AppendEntries ack names entries whose fsync has not completed, and the
+    leader's own self-match reads log_len instead of the durable watermark
+    -- the canonical ack-before-fsync storage bug. A leader counts such an
+    ack toward commit, the acking follower crashes, recovery truncates the
+    un-fsynced suffix, and a committed entry exists on no quorum: a later
+    leader elects without it and commits below the frontier
+    (leader_completeness), and the AE that re-extends the deposed leader
+    mutates its committed prefix (the device commit invariant --
+    state_machine_safety). The disk itself stays honest -- only the
+    acknowledgment lies. Requires cfg.durable_storage
+    (fsync_interval > 0)."""
+
+    @property
+    def durable_acks(self) -> bool:  # type: ignore[override]
+        return False
+
+
+class VolatileVoteConfig(RaftConfig):
+    """Crash recovery forgets votedFor (cfg.persist_vote False): term and
+    log restore from the durable snapshot but the vote does not -- the
+    reference's own restart bug (log.clj:16-18, SURVEY.md 2.3.12) expressed
+    inside the storage plane. A voter grants, crashes, restarts with
+    voted_for == NIL, and grants AGAIN in the same term to a different
+    candidate: two leaders in one term (election_safety). Requires
+    cfg.durable_storage (fsync_interval > 0)."""
+
+    @property
+    def persist_vote(self) -> bool:  # type: ignore[override]
+        return False
+
+
 MUTANTS = {
     "weak-quorum": WeakQuorumConfig,
     "single-server-change": SingleServerChangeConfig,
@@ -129,6 +162,8 @@ MUTANTS = {
     "stale-read": StaleReadConfig,
     "blind-transfer": BlindTransferConfig,
     "lease-skew": LeaseSkewConfig,
+    "ack-before-fsync": AckBeforeFsyncConfig,
+    "volatile-vote": VolatileVoteConfig,
 }
 
 

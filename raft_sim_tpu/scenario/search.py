@@ -74,7 +74,7 @@ def default_knobs(cfg: RaftConfig) -> tuple[Knob, ...]:
     (topology, timers, routing model) are deliberately absent -- genomes must
     never fork a compile. The client cadence stays pinned to cfg (the
     workload is part of the question, not the answer)."""
-    return (
+    base = (
         Knob("drop_prob", 0.0, 0.6),
         Knob("partition_period", 0.0, 64.0, kind="int"),
         Knob("partition_prob", 0.0, 1.0),
@@ -82,6 +82,20 @@ def default_knobs(cfg: RaftConfig) -> tuple[Knob, ...]:
         Knob("crash_down_ticks", 1.0, float(cfg.crash_period), kind="int"),
         Knob("clock_skew_prob", 0.0, 0.3),
     )
+    if cfg.durable_storage:
+        # The disk-fault lattice joins the searched space only when the
+        # config compiles the durable storage plane in (validate() rejects
+        # the axes otherwise). fsync_interval stays >= 1: a zero cadence
+        # never flushes, so the durable watermark pins every ack at 0 and
+        # the hunt collapses into a commit-stall attractor that can never
+        # produce a violation.
+        base += (
+            Knob("fsync_interval", 1.0, 8.0, kind="int"),
+            Knob("fsync_jitter_prob", 0.0, 0.6),
+            Knob("torn_tail_prob", 0.0, 0.6),
+            Knob("lost_suffix_span", 1.0, float(cfg.log_capacity // 2), kind="int"),
+        )
+    return base
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,12 +156,21 @@ def _decode_row(cfg: RaftConfig, knobs, x: np.ndarray) -> genome_mod.ScenarioGen
         "reconfig_interval": cfg.reconfig_interval,
         "transfer_interval": cfg.transfer_interval,
         "read_interval": cfg.read_interval,
+        "fsync_interval": cfg.fsync_interval,
+        "fsync_jitter_prob": cfg.fsync_jitter_prob,
+        "torn_tail_prob": cfg.torn_tail_prob,
+        "lost_suffix_span": cfg.lost_suffix_span,
     }
     for k, xi in zip(knobs, x):
         v = k.lo + float(xi) * (k.hi - k.lo)
         params[k.name] = int(round(v)) if k.kind == "int" else v
     params["crash_down_ticks"] = max(1, min(int(params.get(
         "crash_down_ticks", 1)), cfg.crash_period))
+    params["lost_suffix_span"] = max(1, min(int(params.get(
+        "lost_suffix_span", 1)), cfg.log_capacity))
+    if cfg.durable_storage:
+        params["fsync_interval"] = max(1, int(params.get(
+            "fsync_interval", cfg.fsync_interval)))
     return genome_mod.from_segments([genome_mod.segment(**params)])
 
 

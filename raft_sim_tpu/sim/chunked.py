@@ -45,6 +45,8 @@ def merge_metrics(a: scan.RunMetrics, b: scan.RunMetrics) -> scan.RunMetrics:
         reads_served=a.reads_served + b.reads_served,
         read_lat_sum=a.read_lat_sum + b.read_lat_sum,
         read_hist=a.read_hist + b.read_hist,
+        fsync_lag_sum=a.fsync_lag_sum + b.fsync_lag_sum,
+        fsync_lag_max=jnp.maximum(a.fsync_lag_max, b.fsync_lag_max),
         multi_leader=a.multi_leader + b.multi_leader,
         ticks=a.ticks + b.ticks,
     )

@@ -37,7 +37,8 @@ the config scalars reproduces the scalar path's trajectories BIT-FOR-BIT
 (tests/test_scenario.py pins this). The genome is duck-typed here (fields
 `drop/part_period/part/crash/crash_down/skew/client_interval` plus the
 reconfiguration-plane cadences `reconfig_interval/transfer_interval/
-read_interval`, each a `[S]` per-segment leaf -- see scenario/genome.py);
+read_interval` and the disk-fault axes `fsync_interval/fsync_jitter/
+torn/torn_span`, each a `[S]` per-segment leaf -- see scenario/genome.py);
 sim/ never imports scenario/.
 
 The per-cluster key is split once into disjoint streams (per-tick draws, per-cluster
@@ -187,6 +188,39 @@ def _admin_cmds(cfg: RaftConfig, tkey: jax.Array, now: jax.Array,
     return reconfig_cmd, transfer_cmd, read_cmd
 
 
+def _storage_draws(cfg: RaftConfig, tkey: jax.Array, now: jax.Array,
+                   fs_i, jit_t, torn_t, span, traced: bool):
+    """(fsync_fire, torn_drop) draws -- the durable storage plane's disk-fault
+    lattice (raft_sim_tpu/storage). A node's flush completes on the fsync
+    cadence tick unless its per-node latency-jitter Bernoulli stalls it (the
+    slow-disk model: the due flush waits for the NEXT cadence tick, so the
+    durable watermark falls a full interval behind). torn_drop is the
+    torn-tail write: the extra entries (1..span, uniform) a restart's tail
+    checksum rejects beyond the un-fsynced suffix -- drawn every tick from
+    the dedicated stream so the draw sequence is schedule-independent, and
+    consumed by the kernels only on restart ticks. `fs_i`/`span` are Python
+    ints on the scalar path (statically gated) and traced genome data on the
+    scenario path; `jit_t`/`torn_t` are uint32 thresholds either way. The
+    fold_in(tkey, 7) stream is disjoint from the client-routing (3) and
+    admin-command (5) streams sharing tkey."""
+    n = cfg.n_nodes
+    if traced or cfg.durable_storage:
+        k_jit, k_torn, k_span = jax.random.split(jax.random.fold_in(tkey, 7), 3)
+        stall = bern_u32(k_jit, jit_t, (n,))
+        fire = (fs_i > 0) & (now % jnp.maximum(fs_i, 1) == 0) & ~stall
+        torn = bern_u32(k_torn, torn_t, (n,))
+        # Traced span bound is fine (precedent: crash_down in _alive_at_t).
+        extra = jax.random.randint(k_span, (n,), 1, span + 1)
+        torn_drop = jnp.where(torn, extra, 0).astype(jnp.int32)
+        return fire, torn_drop
+    # Gate off: real (dead) [N] arrays, not the StepInputs Python-int
+    # defaults -- the dtype-comment contract fixes the rank per field, and
+    # these leaves flow through vmap/eval_shape like the admin commands
+    # above (Pass C prices ~5N B/cluster-tick of dead input on every tier;
+    # the kernels never read them when the gate is off).
+    return jnp.zeros((n,), bool), jnp.zeros((n,), jnp.int32)
+
+
 def _client_routing(cfg: RaftConfig, tkey: jax.Array):
     """(client_target, client_bounce) draws -- the redirect-model routing
     randomness (core.clj:154); zeros when the omniscient direct client is
@@ -314,6 +348,10 @@ def make_inputs(
             cfg, tkey, now, g.reconfig_interval, g.transfer_interval,
             g.read_interval, traced=True,
         )
+        fsync_fire, torn_drop = _storage_draws(
+            cfg, tkey, now, g.fsync_interval, g.fsync_jitter, g.torn,
+            g.torn_span, traced=True,
+        )
     else:
         # Message drop (the reference's silently-dropped RPC, client.clj:38-40).
         if cfg.drop_prob > 0:
@@ -374,6 +412,12 @@ def make_inputs(
             cfg, tkey, now, cfg.reconfig_interval, cfg.transfer_interval,
             cfg.read_interval, traced=False,
         )
+        fsync_fire, torn_drop = _storage_draws(
+            cfg, tkey, now, cfg.fsync_interval,
+            jnp.uint32(p_to_u32(cfg.fsync_jitter_prob)),
+            jnp.uint32(p_to_u32(cfg.torn_tail_prob)),
+            cfg.lost_suffix_span, traced=False,
+        )
 
     deliver_mask = bitplane.pack(deliver, axis=1)
     if cfg.compact_planes:
@@ -396,4 +440,6 @@ def make_inputs(
         reconfig_cmd=reconfig_cmd,
         transfer_cmd=transfer_cmd,
         read_cmd=read_cmd,
+        fsync_fire=fsync_fire,
+        torn_drop=torn_drop,
     )

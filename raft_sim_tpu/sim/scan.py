@@ -75,6 +75,13 @@ class RunMetrics(NamedTuple):
     reads_served: jax.Array  # int32
     read_lat_sum: jax.Array  # int32
     read_hist: jax.Array  # [LAT_HIST_BINS] int32
+    # Durability lag (StepInfo.fsync_lag_sum/fsync_lag_max; zeros unless
+    # cfg.durable_storage): summed node-tick lag and the run's max node lag,
+    # in entries of un-fsynced log. The per-cluster mean lag is
+    # fsync_lag_sum / (ticks * N); parallel.summarize rolls fleet
+    # percentiles of those means and the max-of-max.
+    fsync_lag_sum: jax.Array  # int32
+    fsync_lag_max: jax.Array  # int32
     # Split-brain exposure: ticks with >= 2 concurrent LEADER roles
     # (StepInfo.n_leaders). LEGAL under partitions (a deposed leader has not
     # heard the news yet) -- only SAME-term double leadership violates
@@ -114,6 +121,8 @@ def init_metrics() -> RunMetrics:
         reads_served=z,
         read_lat_sum=z,
         read_hist=jnp.zeros((LAT_HIST_BINS,), jnp.int32),
+        fsync_lag_sum=z,
+        fsync_lag_max=z,
         multi_leader=z,
         ticks=z,
     )
@@ -133,6 +142,13 @@ def _host_zero(x) -> bool:
 
 def _add_gated(a, b):
     return a if _host_zero(b) else a + b
+
+
+def _max_gated(a, b):
+    """The maximum-fold twin of _add_gated (same host-predicate gate): used by
+    the fsync-lag max, whose neutral element under max-of-nonnegatives is the
+    same host zero the sum folds skip on."""
+    return a if _host_zero(b) else jnp.maximum(a, b)
 
 
 def step_bad(info):
@@ -174,6 +190,8 @@ def _accumulate(m: RunMetrics, info: StepInfo, tick: jax.Array) -> RunMetrics:
         reads_served=_add_gated(m.reads_served, info.reads_served),
         read_lat_sum=_add_gated(m.read_lat_sum, info.read_lat_sum),
         read_hist=_add_gated(m.read_hist, info.read_hist),
+        fsync_lag_sum=_add_gated(m.fsync_lag_sum, info.fsync_lag_sum),
+        fsync_lag_max=_max_gated(m.fsync_lag_max, info.fsync_lag_max),
         multi_leader=m.multi_leader + (info.n_leaders >= 2),
         ticks=m.ticks + 1,
     )

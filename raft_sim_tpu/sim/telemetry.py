@@ -108,6 +108,8 @@ def init_recorder(cfg: RaftConfig, k: int, batch: int) -> FlightRecorder:
         read_lat_sum=leaf(jnp.int32),
         read_hist=leaf(jnp.int32, LAT_HIST_BINS),
         viol_read_stale=leaf(bool),
+        fsync_lag_sum=leaf(jnp.int32),
+        fsync_lag_max=leaf(jnp.int32),
     )
     return FlightRecorder(
         ring=ring,
@@ -504,7 +506,7 @@ def window_cluster_counters(records: WindowRecord) -> list[dict]:
         f: np.asarray(getattr(records.metrics, f))
         for f in ("ticks", "violations", "first_leader_tick", "total_cmds",
                   "reads_served", "lat_sum", "lat_cnt", "lat_hist",
-                  "read_hist")
+                  "read_hist", "fsync_lag_sum", "fsync_lag_max")
     }
     units = []
     for w in range(n_windows):
@@ -519,6 +521,11 @@ def window_cluster_counters(records: WindowRecord) -> list[dict]:
             "lat_cnt": m["lat_cnt"][:, w].astype(np.int64),
             "lat_hist": m["lat_hist"][:, w].astype(np.int64),
             "read_hist": m["read_hist"][:, w].astype(np.int64),
+            # Durable storage plane (raft_sim_tpu/storage): node-tick-summed
+            # and window-max fsync lag (log_len - dur_len). All-zero when
+            # the plane is off (the gated StepInfo legs are host zeros).
+            "fsync_lag_sum": m["fsync_lag_sum"][:, w].astype(np.int64),
+            "fsync_lag_max": m["fsync_lag_max"][:, w].astype(np.int64),
         })
     return units
 

@@ -139,6 +139,21 @@ def _check_cluster(c: int, evs: list[Event], fail) -> None:
     # leader legally captures a stale index it can never confirm (the real
     # kernel's quorum round kills it; only a served stale read violates).
     pending_reads: dict[int, tuple[int, int, Event]] = {}  # node -> (idx, frontier, ev)
+    # Vote-durability model (raft_sim_tpu/storage). Under the durable
+    # storage plane a cast vote is EXPOSED only once a flush covers it
+    # (section-3.8 gate 2), and crash recovery rewinds votedFor to the
+    # durable snapshot -- so a vote cast after the node's last flush is
+    # legally un-promised by a restart, and counting it against a
+    # post-recovery re-vote would fail the REAL kernel. Votes therefore sit
+    # in `pending_votes` until the node's next EV_FSYNC makes them durable
+    # (clears the pending set; the votes stay cast), and an EV_RESTART
+    # un-casts whatever is still pending. The model activates only when the
+    # history shows the plane (any storage event): perfect-disk histories
+    # keep the strict rule. Known limit: a durability history whose every
+    # flush stalled shows no storage event, so a never-flushed vote stays
+    # cast -- but such a run exposes no votes and elects no leaders either.
+    durable = any(e.kind in (tev.EV_FSYNC, tev.EV_RECOVER_TRUNC) for e in evs)
+    pending_votes: dict[int, list[tuple[int, int]]] = {}  # node -> [(term, cand)]
     for e in evs:
         k = e.kind
         if k in (tev.EV_FOLLOWER, tev.EV_PRECANDIDATE, tev.EV_CANDIDATE):
@@ -164,6 +179,13 @@ def _check_cluster(c: int, evs: list[Event], fail) -> None:
                     f"{e.detail} (config epoch {ce}) in term {t}",
                 )
             votes_cast[(e.node, t)] = (e.detail, ce, e)
+            if durable:
+                pending_votes.setdefault(e.node, []).append((t, e.detail))
+        elif k == tev.EV_FSYNC:
+            # The flush covers the node's live (term, votedFor): every
+            # pending vote is durable now -- it survives restarts and stays
+            # in votes_cast permanently.
+            pending_votes.pop(e.node, None)
         elif k == tev.EV_READ_ISSUE:
             pending_reads[e.node] = (e.detail, frontier, e)
         elif k == tev.EV_READ_SERVE:
@@ -231,6 +253,20 @@ def _check_cluster(c: int, evs: list[Event], fail) -> None:
             restarted_since[e.node] = True
             leader_set.pop(e.node, None)  # restart wipes role (defensive:
             # the same-tick EV_FOLLOWER, ordered first, already removed it)
+            if e.detail > 0:
+                # detail = the post-tick term: recovery can REWIND the term
+                # (a decrease the EV_TERM increase-delta never reports), so
+                # re-anchor the model here. Pre-storage-plane histories
+                # carry detail 0 -- skip, the old model had no rewinds.
+                node_term[e.node] = e.detail
+            for t, cand in pending_votes.pop(e.node, []):
+                # Un-cast never-flushed votes: recovery rewound votedFor to
+                # the durable snapshot, and gate 2 means the grant was never
+                # exposed -- the protocol never saw it, so a post-recovery
+                # re-vote in the same term is NOT a double vote.
+                cur = votes_cast.get((e.node, t))
+                if cur is not None and cur[0] == cand:
+                    votes_cast.pop((e.node, t))
         elif k == tev.EV_VIOLATION:
             if e.detail & tev.VIOL_LOG_MATCHING:
                 fail(

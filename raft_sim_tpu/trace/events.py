@@ -23,7 +23,8 @@ Extraction is delta-based on purpose: both kernels (models/raft.py and
 models/raft_batched.py) produce the same ClusterState leaves, so ONE
 extractor serves both (and any step_fn override, e.g. the weak-quorum test
 mutant) without either kernel changing. The leaves read here -- role, term,
-voted_for, commit_index, log_len -- are the delta contract the kernels
+voted_for, commit_index, log_len, and (durable storage plane) the
+dur_len/dur_term/dur_vote watermarks -- are the delta contract the kernels
 document; everything is elementwise over the node axis, so the same code
 runs on single-cluster [N] leaves and batch-minor [N, B] leaves.
 """
@@ -95,9 +96,29 @@ EV_READ_SERVE = 15
 EV_CFG_APPEND = 16
 EV_CFG_APPLY = 17
 EV_CFG_ROLLBACK = 18
-EV_VIOLATION = 19
-EV_PARTITION = 20
-N_KINDS = 21
+# Durable storage plane kinds (raft_sim_tpu/storage), PER NODE. They slot in
+# numerically BEFORE the cluster kinds (which shifted 19/20 -> 21/22 at trace
+# schema N_KINDS=23): the slot table is kind-major ascending with the cluster
+# kinds last, so every per-node kind must number below them. Both replay after
+# EV_RESTART -- the checker's vote-durability model needs the restart's
+# un-cast to land before the same tick's covering flush clears the pending
+# set. detail semantics:
+#   fsync          the node's new durable length (dur_len) after the flush;
+#                  the flag fires on ANY durable-snapshot advance (dur_len
+#                  up, or dur_term/dur_vote changed -- phase 7.5 is the only
+#                  writer that moves them that way, so the event IS a
+#                  completed flush; the truncation clamp only lowers dur_len
+#                  and recovery never touches the snapshot)
+#   recover_trunc  the node's recovered log length: a log_len DROP on a
+#                  `restarted` node is always the recovery truncation
+#                  (restarted nodes receive nothing, so the AE conflict
+#                  truncation cannot co-occur); the same delta also fires
+#                  the plain EV_TRUNCATE -- this kind marks it as recovery
+EV_RECOVER_TRUNC = 19
+EV_FSYNC = 20
+EV_VIOLATION = 21
+EV_PARTITION = 22
+N_KINDS = 23
 
 KINDS = {
     "follower": EV_FOLLOWER,
@@ -120,6 +141,8 @@ KINDS = {
     "cfg_append": EV_CFG_APPEND,
     "cfg_apply": EV_CFG_APPLY,
     "cfg_rollback": EV_CFG_ROLLBACK,
+    "fsync": EV_FSYNC,
+    "recover_trunc": EV_RECOVER_TRUNC,
 }
 KIND_NAMES = {v: k for k, v in KINDS.items()}
 
@@ -131,7 +154,13 @@ PER_NODE_KINDS = (
     EV_COMMIT, EV_APPEND, EV_TRUNCATE, EV_CRASH, EV_RESTART, EV_DROP,
     EV_XFER, EV_READ_ISSUE, EV_READ_SERVE,
     EV_CFG_APPEND, EV_CFG_APPLY, EV_CFG_ROLLBACK,
+    # Storage kinds replay LAST among per-node kinds: recovery precedes the
+    # flush in the kernel (phase -1 vs 7.5), and the checker's vote-
+    # durability model needs the restart's un-cast (EV_RESTART, above) to
+    # land before the same tick's covering flush clears the pending set.
+    EV_RECOVER_TRUNC, EV_FSYNC,
 )
+assert PER_NODE_KINDS == tuple(sorted(PER_NODE_KINDS))  # slot order == kind order
 CLUSTER_KINDS = (EV_VIOLATION, EV_PARTITION)
 
 # Violation bitmask bits (EV_VIOLATION detail).
@@ -215,18 +244,31 @@ def extract(
     burst = dropped >= max(1, (n + 1) // 2)
 
     # Per-node (flag, detail) blocks, in PER_NODE_KINDS order.
+    vote_flag = (new.voted_for != old.voted_for) & (new.voted_for != NIL)
+    if cfg.durable_storage:
+        # Recovery REWINDS votedFor to the durable snapshot on restart ticks
+        # (storage/plane.recover): that state change is not a grant, and a
+        # restarted node receives nothing this tick so no genuine grant can
+        # co-occur -- suppress, or the checker would read the rewind as a
+        # second vote. (Gated: without the plane restart preserves votedFor
+        # and the suppression would be dead structure in the program.)
+        vote_flag = vote_flag & ~inp.restarted
     blocks = (
         (became(FOLLOWER), new.term),
         (became(PRECANDIDATE), new.term),
         (became(CANDIDATE), new.term),
         (became(LEADER), new.term),
         (new.term > old.term, new.term),
-        ((new.voted_for != old.voted_for) & (new.voted_for != NIL), new.voted_for),
+        (vote_flag, new.voted_for),
         (new.commit_index > old.commit_index, new.commit_index),
         (new.log_len > old.log_len, new.log_len),
         (new.log_len < old.log_len, new.log_len),
         (crashed, z32),
-        (inp.restarted, z32),
+        # Restart detail = the node's POST-tick term: recovery can rewind
+        # the term (a decrease the EV_TERM increase-delta cannot see), so
+        # the checker re-anchors its per-node term model here. Pre-storage-
+        # plane histories carry detail 0 (the checker skips those).
+        (inp.restarted, new.term),
         (burst, dropped),
     )
     # Reconfiguration-plane kinds, delta-derived like everything else (the
@@ -267,6 +309,21 @@ def extract(
         cfg_append_d = z32
         cfg_apply = jnp.zeros(new.term.shape, bool)
         cfg_rollback = jnp.zeros(new.term.shape, bool)
+    # Durable storage plane kinds (kind-numbering comment above): flush =
+    # any durable-snapshot advance; recovery truncation = log drop on a
+    # restarted node. Structurally gated like the config kinds -- without
+    # the plane the dur legs are carry passthroughs and the compares would
+    # be constant-false dead work.
+    if cfg.durable_storage:
+        fsync_flag = (
+            (new.dur_len > old.dur_len)
+            | (new.dur_term != old.dur_term)
+            | (new.dur_vote != old.dur_vote)
+        )
+        rec_trunc = inp.restarted & (new.log_len < old.log_len)
+    else:
+        fsync_flag = jnp.zeros(new.term.shape, bool)
+        rec_trunc = jnp.zeros(new.term.shape, bool)
     blocks = blocks + (
         (xfer_flag, new.xfer_to),
         (read_issue, new.read_idx - 1),
@@ -274,6 +331,8 @@ def extract(
         (cfg_append, cfg_append_d),
         (cfg_apply, new.cfg_epoch),
         (cfg_rollback, new.cfg_epoch),
+        (rec_trunc, new.log_len),
+        (fsync_flag, new.dur_len),
     )
     viol_mask = (
         info.viol_election_safety * VIOL_ELECTION

@@ -328,6 +328,21 @@ class ClusterState(NamedTuple):
     # log-matching compare, and restart-persistent alongside the log it tags.
     log_tick: jax.Array  # [N, CAP] int32
     log_len: jax.Array  # [N] int32
+    # Durable storage plane (raft_sim_tpu/storage; all legs zeros/boot values
+    # and carried untouched unless cfg.durable_storage). The dissertation's
+    # section 3.8 persistent triple -- currentTerm, votedFor, the log -- is
+    # durable only up to these watermarks: entries (0, dur_len] have been
+    # fsynced, and dur_term/dur_vote are the term/vote as of the last flush.
+    # A flush (StepInputs.fsync_fire) snaps all three to the node's live
+    # values; a crash-restart REWINDS the node to them (the un-fsynced log
+    # suffix is lost, term/vote revert to the durable snapshot), minus any
+    # torn tail (StepInputs.torn_drop) the recovery checksum rejects.
+    # Truncation clamps dur_len down with log_len (removed entries are no
+    # longer durable as log content). v1 excludes compaction (dur_len would
+    # have to fold across snapshot installs) -- asserted by RaftConfig.
+    dur_len: jax.Array  # [N] int32: fsynced log prefix length (<= log_len)
+    dur_term: jax.Array  # [N] int32: term at the last flush (boot: 1)
+    dur_vote: jax.Array  # [N] int32: votedFor at the last flush (NIL = none)
     clock: jax.Array  # [N] int32 local (skewable) clock
     deadline: jax.Array  # [N] int32 next timer fire on the local clock
     # Local-clock stamp of the last valid leader contact (accepted current-term
@@ -471,6 +486,17 @@ class StepInputs(NamedTuple):
     reconfig_cmd: jax.Array = NIL  # scalar int32 in [0, N); NIL = none
     transfer_cmd: jax.Array = NIL  # scalar int32 in [0, N); NIL = none
     read_cmd: jax.Array = NIL  # scalar int32 0/1 flag encoded as value; NIL = none
+    # Durable storage plane draws (cfg.durable_storage; all-zero arrays
+    # otherwise -- sim/faults._storage_draws). fsync_fire marks the nodes
+    # whose disk completes a flush THIS tick (the cadence tick, minus the
+    # per-node latency-jitter stall draw); torn_drop is the extra entries a
+    # recovery's tail checksum rejects IF the node restarts this tick (the
+    # torn-tail write; consumed only on restart ticks, drawn every tick so
+    # the key stream is schedule-independent). Python-scalar defaults like
+    # the admin commands above; make_inputs always materializes real [N]
+    # arrays (the dtype-comment contract fixes the rank per field).
+    fsync_fire: jax.Array = False  # [N] bool; True = flush completes this tick
+    torn_drop: jax.Array = 0  # [N] int32: torn-tail entries dropped at recovery
 
 
 class StepInfo(NamedTuple):
@@ -545,6 +571,16 @@ class StepInfo(NamedTuple):
     # device-visible form of the checker's read_linearizability property.
     # Defaulted so hand-built StepInfos predating the lease plane stay valid.
     viol_read_stale: jax.Array = False  # bool: a stale lease read was served
+    # Durability lag (cfg.durable_storage; host-constant zeros otherwise, with
+    # the folds gated like the read metrics -- scan._accumulate): how far the
+    # simulated disks trail the live logs at end of tick, as the sum and max
+    # over nodes of (log_len - dur_len). The health plane's durability_lag
+    # SLI and the per-window fsync-lag counters read these; a disk that
+    # stalls (fsync_jitter_prob) shows up here before it shows up as a
+    # replication stall. Defaulted so hand-built StepInfos predating the
+    # storage plane stay valid.
+    fsync_lag_sum: jax.Array = 0  # int32: sum over nodes of log_len - dur_len
+    fsync_lag_max: jax.Array = 0  # int32: max over nodes of log_len - dur_len
 
 
 def empty_mailbox(cfg: RaftConfig) -> Mailbox:
@@ -607,6 +643,12 @@ def init_state(cfg: RaftConfig, key: jax.Array) -> ClusterState:
         log_val=jnp.zeros((n, cap), jnp.int32),
         log_tick=jnp.zeros((n, cap), jnp.int32),
         log_len=jnp.zeros((n,), jnp.int32),
+        # Durable boot state: the empty log is trivially durable, and the
+        # boot term-1/no-vote pair counts as flushed (a node that crashes
+        # before its first flush recovers to boot state, not to garbage).
+        dur_len=jnp.zeros((n,), jnp.int32),
+        dur_term=jnp.ones((n,), jnp.int32),
+        dur_vote=jnp.full((n,), NIL, jnp.int32),
         clock=jnp.zeros((n,), jnp.int32),
         deadline=deadline,
         # "Quiet since before time began": pre-votes are grantable at boot.

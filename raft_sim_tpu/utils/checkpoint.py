@@ -108,7 +108,15 @@ from raft_sim_tpu.utils.config import RaftConfig
 #      req_base_epoch). All new leaves are zeros and loop-invariant unless
 #      cfg.reconfig (and the snapshot legs additionally need
 #      cfg.compaction). RunMetrics unchanged.
-_FORMAT_VERSION = 24
+# v25: durable storage plane (ISSUE 19; raft_sim_tpu/storage) --
+#      ClusterState gained the durable watermark triple: dur_len ([N] int32
+#      entries the disk confirmed), dur_term/dur_vote ([N] int32 durable
+#      term/votedFor snapshots; boot values 0/1/NIL match init_state's live
+#      triple so a cold cluster is born consistent). RunMetrics gained the
+#      fsync lag accumulators (fsync_lag_sum/fsync_lag_max, telemetry
+#      schema v4). All new leaves are loop-invariant unless
+#      cfg.durable_storage (fsync_interval > 0). Mailbox unchanged.
+_FORMAT_VERSION = 25
 
 # The single exported source of truth for the on-disk format version
 # (re-exported as raft_sim_tpu.CHECKPOINT_FORMAT_VERSION). Everything that
@@ -124,7 +132,7 @@ FORMAT_VERSION = _FORMAT_VERSION
 # refreshing this pin -- the convention the v2..v19 log always relied on,
 # now machine-checked. Refresh with:
 #     python -c "from raft_sim_tpu.analysis import policy; print(policy.schema_fingerprint())"
-_SCHEMA_FINGERPRINT = (24, "37bbb4a654ebd158")
+_SCHEMA_FINGERPRINT = (25, "541dcec1cfa9709e")
 
 
 def _normalize(path: str) -> str:

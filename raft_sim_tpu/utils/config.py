@@ -134,6 +134,35 @@ class RaftConfig:
     # slot first. 1 = the round-4 single-command client.
     client_pipeline: int = 1
 
+    # Durable storage plane (raft_sim_tpu/storage; dissertation section 3.8's
+    # persistence requirements made falsifiable). The reference persists its
+    # log through a file-backed atom (log.clj:16-18) whose restart path
+    # forgets term/vote (bug 2.3.12); with this gate OFF the simulator models
+    # the opposite extreme -- a PERFECT disk where every write is durable the
+    # instant it happens -- so the whole class of durability failures is
+    # inexpressible. A nonzero `fsync_interval` turns on the explicit
+    # persistence model: each node carries durable watermarks (dur_len +
+    # durable term/vote snapshots) advanced only when its fsync completes
+    # (cadence `fsync_interval` ticks, each due flush stalled to the next
+    # cadence tick with prob `fsync_jitter_prob` -- the latency lattice),
+    # AppendEntries acks and vote grants reflect ONLY durable state (the
+    # section 3.8 gate: replication stalls behind a slow disk instead of
+    # lying), and crash recovery truncates the un-fsynced log suffix and
+    # rewinds term/vote to the durable snapshot. A restart's durable tail may
+    # additionally be TORN (prob `torn_tail_prob` per restart): the WAL
+    # checksum detects the partial record and recovery drops up to
+    # `lost_suffix_span` extra entries. Structural-gate contract like
+    # client_interval: the nonzero cadence decides which carry legs compile;
+    # the cadence/probability VALUES are tunable (the scenario genome retimes
+    # them as data -- disk-fault axes, scenario/genome.py). v1 restriction:
+    # mutually exclusive with ring-log compaction (compact_margin > 0) -- the
+    # durable watermark would need to fold across snapshot installs and
+    # compaction rebases; lift when a workload needs both.
+    fsync_interval: int = 0
+    fsync_jitter_prob: float = 0.0
+    torn_tail_prob: float = 0.0
+    lost_suffix_span: int = 1
+
     # Standing-fleet serving (raft_sim_tpu/serve). When True, the simulator
     # expects externally ingested client commands (driver `serve`,
     # Session.offer) even with client_interval == 0, so the offer-tick plane
@@ -282,6 +311,35 @@ class RaftConfig:
         assert self.reconfig_interval >= 0
         assert self.transfer_interval >= 0
         assert self.read_interval >= 0
+        # Durable storage plane (raft_sim_tpu/storage): the fsync cadence is
+        # the structural gate; the disk-fault probabilities only have a
+        # reader when it is on.
+        assert self.fsync_interval >= 0
+        assert 0.0 <= self.fsync_jitter_prob <= 1.0
+        assert 0.0 <= self.torn_tail_prob <= 1.0
+        if self.fsync_interval > 0:
+            # v1 restriction: no ring-log compaction under the durability
+            # model. The durable watermark (dur_len) tracks a plain-prefix
+            # log; folding it across snapshot installs and compaction
+            # rebases (the base/bterm/bchk triple becoming durable state)
+            # is a designed follow-up, not a silent interaction.
+            assert self.compact_margin == 0, (
+                "fsync_interval > 0 is v1-incompatible with compact_margin "
+                "> 0: the durable watermark does not fold across snapshot "
+                "installs yet (raft_sim_tpu/storage docstring)"
+            )
+            # The torn-tail draw removes 1..span extra entries at recovery;
+            # a span past the log capacity could never matter.
+            assert 1 <= self.lost_suffix_span <= self.log_capacity
+        else:
+            assert self.torn_tail_prob == 0.0, (
+                "torn_tail_prob needs the durable storage plane: set a "
+                "nonzero fsync_interval as the base cadence it perturbs"
+            )
+            assert self.fsync_jitter_prob == 0.0, (
+                "fsync_jitter_prob needs the durable storage plane: set a "
+                "nonzero fsync_interval as the base cadence it perturbs"
+            )
         assert self.reconfig_interval == 0 or self.n_nodes >= 3
         assert self.read_lease_ticks >= 0
         if self.read_lease_ticks > 0:
@@ -371,6 +429,15 @@ class RaftConfig:
         viol_read_stale device invariant go live."""
         return self.read_lease_ticks > 0
 
+    @property
+    def durable_storage(self) -> bool:
+        """True when the durable storage plane is active (fsync_interval >
+        0): the per-node durable watermarks (dur_len/dur_term/dur_vote)
+        compile into the carry, the section-3.8 gates into ack/grant
+        handling, and crash recovery truncates to the durable snapshot
+        (raft_sim_tpu/storage)."""
+        return self.fsync_interval > 0
+
     # -- TEST-ONLY mutation hooks (scenario/mutation.py). Each extension's
     # correctness hinges on one rule; these properties are that rule as data,
     # so a mutant config subclass can weaken exactly it and the CE hunt must
@@ -431,6 +498,28 @@ class RaftConfig:
         global time, a new leader commits inside the optimistic lease, and
         the deposed leader serves a stale read -- the thesis-6.4.1 clock
         assumption made falsifiable (the hunt drives the skew genome axis)."""
+        return True
+
+    @property
+    def durable_acks(self) -> bool:
+        """False (mutants only): AppendEntries acks and vote grants reflect
+        the node's VOLATILE state -- an ack can name entries whose fsync has
+        not completed, and a grant can precede the vote's persistence. The
+        canonical ack-before-fsync storage bug: a leader counts a follower's
+        acked-but-unfsynced entries toward commit, the follower crashes, and
+        recovery truncates entries the cluster already reported committed --
+        committed-entry loss (leader_completeness). Recovery still truncates
+        honestly; only the acknowledgment lies."""
+        return True
+
+    @property
+    def persist_vote(self) -> bool:
+        """False (mutants only): crash recovery restores term/log from the
+        durable snapshot but forgets votedFor -- the reference's own restart
+        bug (log.clj:16-18, SURVEY.md 2.3.12) expressed inside the storage
+        plane. A restarted voter re-grants in a term it already voted in, two
+        candidates each reach "quorum", and two leaders share the term
+        (election_safety)."""
         return True
 
     @property
@@ -642,6 +731,34 @@ PRESETS: dict[str, tuple[RaftConfig, int]] = {
             compact_planes=True,
         ),
         250,
+    ),
+    # Durable-storage acceptance preset (raft_sim_tpu/storage; ISSUE 19): the
+    # fsync/WAL model live under the full disk-fault lattice -- a 3-tick
+    # fsync cadence with 20% latency jitter, torn durable tails on 30% of
+    # restarts (up to 3 extra entries dropped at recovery), crash churn so
+    # recovery actually runs, and client traffic + drops so the section-3.8
+    # ack gate is exercised under replication pressure, not just elections.
+    # Compaction stays off (the v1 restriction above). The trace checker must
+    # pass all six properties over its histories while the ack-before-fsync /
+    # volatile-vote mutants of the same preset are rejected naming
+    # leader_completeness / election_safety (tests/test_storage.py, CI
+    # durability smoke).
+    "config10": (
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=64,
+            max_entries_per_rpc=4,
+            client_interval=4,
+            drop_prob=0.1,
+            crash_prob=0.3,
+            crash_period=64,
+            crash_down_ticks=12,
+            fsync_interval=3,
+            fsync_jitter_prob=0.2,
+            torn_tail_prob=0.3,
+            lost_suffix_span=3,
+        ),
+        1_000,
     ),
     # config4's fault mix carrying client traffic, so offer->commit latency is
     # measured UNDER faults in the standing bench (not only on reliable nets).

@@ -83,7 +83,11 @@ from raft_sim_tpu.utils.config import RaftConfig
 #  3: windows.jsonl gained the ReadIndex read-traffic columns (reads,
 #     read_lat_sum, read_hist -- the read-side mirror of the commit-latency
 #     fields; zeros unless cfg.read_index).
-TELEMETRY_SCHEMA_VERSION = 3
+#  4: windows.jsonl gained the durable-storage fsync-lag columns
+#     (fsync_lag_sum = node-tick-summed log_len - dur_len over the window,
+#     fsync_lag_max = its per-tick per-node max -- the durability_lag SLI's
+#     inputs, health/spec.py; zeros unless cfg.durable_storage).
+TELEMETRY_SCHEMA_VERSION = 4
 
 # A "never happened" tick sentinel (scan.NEVER) becomes JSON null.
 _NEVER = 2**31 - 1
@@ -108,6 +112,8 @@ WINDOW_FIELDS = (
     "multi_leader",
     "reads",
     "read_lat_sum",
+    "fsync_lag_sum",
+    "fsync_lag_max",
 )
 
 # Per-line required fields of perf.jsonl (obs/timer.py ChunkTimer rows).
@@ -182,6 +188,10 @@ def window_lines(records, first_index: int) -> list[dict]:
             "read_lat_sum": int(
                 m["read_lat_sum"].astype(np.int64)[:, w].sum()
             ),
+            "fsync_lag_sum": int(
+                m["fsync_lag_sum"].astype(np.int64)[:, w].sum()
+            ),
+            "fsync_lag_max": int(m["fsync_lag_max"][:, w].max()),
             "lat_hist": [
                 int(x) for x in m["lat_hist"].astype(np.int64)[:, w].sum(axis=0)
             ],

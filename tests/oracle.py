@@ -216,6 +216,12 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
     base_pend = np.asarray(s["base_pend"], np.int32).copy()  # [N]
     base_epoch = np.asarray(s["base_epoch"], np.int32).copy()  # [N]
     xfer_to = np.asarray(s["xfer_to"], np.int32).copy()
+    # Durable storage plane (models/raft.py phase -1 / 7.5): the fsynced
+    # prefix length and the term/vote snapshot at the last completed flush.
+    dur = cfg.durable_storage
+    dur_len = np.asarray(s["dur_len"], np.int32).copy()
+    dur_term = np.asarray(s["dur_term"], np.int32).copy()
+    dur_vote = np.asarray(s["dur_vote"], np.int32).copy()
     read_idx = s["read_idx"].copy()
     read_tick = s["read_tick"].copy()
     read_acks = np.asarray(s["read_acks"], bool).copy()
@@ -237,6 +243,18 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
             commit[d] = log_base[d]
             commit_chk[d] = base_chk[d]
             deadline[d] = int(s["clock"][d]) + int(inp["timeout_draw"][d])
+            if dur:
+                # Crash recovery: reload the durable term/vote snapshot and
+                # truncate the un-fsynced (possibly torn) log suffix. The
+                # fsync watermark FLOORS the recovered length -- a completed
+                # flush never tears -- so torn_drop eats only the volatile
+                # tail (models/raft.py phase -1).
+                term[d] = dur_term[d]
+                voted_for[d] = int(dur_vote[d]) if cfg.persist_vote else NIL
+                log_len[d] = max(
+                    int(dur_len[d]),
+                    int(s["log_len"][d]) - int(inp["torn_drop"][d]),
+                )
             if cfg.pre_vote or rdl or rcf:
                 # a restarted node remembers no leader contact (pre-votes
                 # grantable; under the lease or log-carried-config denial
@@ -493,6 +511,11 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         a_ok_to[d] = src
         a_match[d] = last_new
 
+    # Durable watermark after the AE conflict truncation (models/raft.py
+    # phase 3): a truncation below the watermark drags it down with the log.
+    if dur:
+        dur_mid = np.minimum(dur_len, log_len.astype(np.int32))
+
     # NACK catch-up hint: every unsuccessful AE response carries the responder's
     # (post-append) log length -- the conflict-index optimization (raft.py
     # phase 3). Per responder: the same hint toward every nacked sender.
@@ -657,7 +680,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         if role[d] != LEADER or not alive[d]:
             continue
         match = match_index[d].copy()
-        match[d] = log_len[d]
+        # A leader's own quorum vote is its DURABLE length under the storage
+        # plane's ack gate (models/raft.py phase 5).
+        match[d] = dur_mid[d] if (dur and cfg.durable_acks) else log_len[d]
         if rcf:
             # Each leader's OWN derived configuration masks its commit
             # quorum (tick-start rows; models/raft.py phase 5).
@@ -1006,6 +1031,40 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                     votes[d, d] = True
                     deadline[d] = clock[d] + int(inp["timeout_draw"][d])
 
+    # ---- phase 7.5: fsync flush + durability gates (models/raft.py) --------
+    # After elections finalize term/votedFor and injection finalizes log_len:
+    # a completing flush snaps the durable snapshot to the live triple. Gates
+    # (cfg.durable_acks; False = TEST-ONLY ack-before-fsync mutant): AE acks
+    # reflect only the fsynced prefix, and a vote grant is exposed only once
+    # the durable snapshot covers it -- the covering flush emits the withheld
+    # response (late_grant overlay in the outbox below).
+    late_grant = np.zeros(n, bool)
+    if dur:
+        dur2_len = dur_mid.astype(np.int32).copy()
+        dur2_term = dur_term.copy()
+        dur2_vote = dur_vote.copy()
+        for d in range(n):
+            if bool(inp["fsync_fire"][d]) and alive[d]:  # dead disks never flush
+                dur2_len[d] = log_len[d]
+                dur2_term[d] = term[d]
+                dur2_vote[d] = voted_for[d]
+        if cfg.durable_acks:
+            a_match = np.minimum(a_match, dur2_len)
+            for d in range(n):
+                covered0 = (
+                    int(dur_term[d]) == int(term[d])
+                    and int(dur_vote[d]) == int(voted_for[d])
+                    and int(voted_for[d]) != NIL
+                )
+                covered2 = (
+                    int(dur2_term[d]) == int(term[d])
+                    and int(dur2_vote[d]) == int(voted_for[d])
+                    and int(voted_for[d]) != NIL
+                )
+                v_to[d] = int(voted_for[d]) if covered2 else NIL
+                late_grant[d] = covered2 and not covered0 and not granted_any[d]
+        dur_len, dur_term, dur_vote = dur2_len, dur2_term, dur2_vote
+
     # ---- phase 8: outbox (wire format v8: per-sender headers + per-edge offsets)
     z = lambda *shape: np.zeros(shape, np.int32)
     out = {
@@ -1139,6 +1198,16 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
                 # The grant bit rides the (packed) pv_grant plane, not the kind.
                 out["pv_grant"][q, r] = bool(pv_grant[r, q])
             out["resp_kind"][q, r] = rtype
+    if dur and cfg.durable_acks:
+        # Late vote-completion response (phase 7.5): the flush that made this
+        # voter's grant durable emits the RESP_VOTE the grant tick withheld --
+        # toward the recorded candidate, only where the edge carries no
+        # response already (models/raft.py for the AE-collision argument).
+        for r in range(n):
+            if late_grant[r]:
+                q = int(voted_for[r])
+                if out["resp_kind"][q, r] == 0:
+                    out["resp_kind"][q, r] = RESP_VOTE
 
     # Monotone commit-latency frontier (types.ClusterState.lat_frontier):
     # measurement state maintained only under client workloads, deduping the
@@ -1230,6 +1299,9 @@ def oracle_step(cfg, s: dict, inp: dict) -> dict:
         "log_val": log_val,
         "log_tick": log_tick,
         "log_len": log_len,
+        "dur_len": dur_len,
+        "dur_term": dur_term,
+        "dur_vote": dur_vote,
         "clock": clock,
         "deadline": deadline,
         "heard_clock": heard_clock,

@@ -165,12 +165,15 @@ def test_types_comments_parse_and_hold():
     # v22: +member_old/member_new/cfg_epoch/cfg_pend (joint-consensus
     # membership plane), +xfer_to (TimeoutNow), +read_idx/read_tick/read_acks
     # (ReadIndex slot); v24: +log_cfg (config-entry plane) +base_mold/
-    # base_pend/base_epoch (snapshot config context)
-    assert len(specs["ClusterState"]) == 38  # v23: +read_fr (lease anchor)
+    # base_pend/base_epoch (snapshot config context); v25: +dur_len/dur_term/
+    # dur_vote (storage plane's durable watermarks)
+    assert len(specs["ClusterState"]) == 41  # v23: +read_fr (lease anchor)
     # v24: +req_disrupt +ent_cfg +req_base_mold/req_base_pend/req_base_epoch
     assert len(specs["Mailbox"]) == 28  # v22: +xfer_tgt
-    assert len(specs["StepInputs"]) == 11  # v22: +reconfig/transfer/read cmds
-    assert len(specs["StepInfo"]) == 20  # v23: +viol_read_stale
+    # v25: +fsync_fire/torn_drop (disk-fault lattice draws)
+    assert len(specs["StepInputs"]) == 13  # v22: +reconfig/transfer/read cmds
+    # v25: +fsync_lag_sum/fsync_lag_max (durability-lag SLI counters)
+    assert len(specs["StepInfo"]) == 22  # v23: +viol_read_stale
     assert ast_lint.check_dtype_comments() == []
 
 

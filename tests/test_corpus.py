@@ -42,16 +42,18 @@ CORPUS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "corpus")
 ARTIFACTS = sorted(glob.glob(os.path.join(CORPUS_DIR, "*.json")))
 _IDS = [os.path.basename(p) for p in ARTIFACTS]
 
-# Budget re-tier (ISSUE 13): the checker-both-ways gates compile ~2 traced
-# replay programs PER artifact (~15-40s each on this tier's CPU), and the
-# corpus grew to five. Tier-1 keeps the checker gates for the newest
-# (log-carried) artifacts -- the ISSUE-13 acceptance pair, not yet covered
-# anywhere else -- while the three pre-v24 artifacts ride the slow tier:
-# their BIT-EXACT replay stays tier-1 via the one-command corpus replay
-# below (the "every hunted bug stays found" contract), and their checker
-# semantics are re-proven every CI run (trace smoke: weak-quorum; reconfig
-# smoke: blind-transfer hunt; lease smoke: lease-skew both ways).
-_TIER1_CHECKED = {"act-on-commit-n5.json", "single-server-change-n5.json"}
+# Budget re-tier (ISSUE 13, rolled forward by ISSUE 19): the checker-both-
+# ways gates compile ~2 traced replay programs PER artifact (~15-40s each
+# on this tier's CPU), and the corpus grew to seven. Tier-1 keeps the
+# checker gates for the newest (durable-storage) artifacts -- the ISSUE-19
+# acceptance pair, not yet covered anywhere else -- while the older
+# artifacts ride the slow tier: their BIT-EXACT replay stays tier-1 via the
+# one-command corpus replay below (the "every hunted bug stays found"
+# contract), and their checker semantics are re-proven every CI run (trace
+# smoke: weak-quorum; reconfig smoke: blind-transfer hunt; lease smoke:
+# lease-skew both ways; log-carried smoke: act-on-commit /
+# single-server-change).
+_TIER1_CHECKED = {"ack-before-fsync-n5.json", "volatile-vote-n5.json"}
 _CHECKED_PARAMS = [
     p if os.path.basename(p) in _TIER1_CHECKED
     else pytest.param(p, marks=pytest.mark.slow)

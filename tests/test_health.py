@@ -97,6 +97,8 @@ def _unit(batch=4, start=0, ticks=16, **fields):
         "lat_cnt": np.zeros(batch, np.int64),
         "lat_hist": np.zeros((batch, LAT_HIST_BINS), np.int64),
         "read_hist": np.zeros((batch, LAT_HIST_BINS), np.int64),
+        "fsync_lag_sum": np.zeros(batch, np.int64),
+        "fsync_lag_max": np.zeros(batch, np.int64),
     }
     u.update(fields)
     return u
@@ -617,6 +619,8 @@ def test_monitor_observe_chunk_and_begin_run():
             lat_sum=np.zeros(2, np.int64), lat_cnt=np.zeros(2, np.int64),
             lat_hist=np.zeros((2, LAT_HIST_BINS), np.int64),
             read_hist=np.zeros((2, LAT_HIST_BINS), np.int64),
+            fsync_lag_sum=np.zeros(2, np.int64),
+            fsync_lag_max=np.zeros(2, np.int64),
             first_leader_tick=np.array(first),
         )
 

@@ -323,6 +323,50 @@ CONFIGS = [
         # quorums, the thesis-4.2.3 vote denial on skewed local clocks, and
         # the read_fr staleness anchor riding capture/serve/cancel/restart
     ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            client_interval=2,
+            fsync_interval=3,
+            fsync_jitter_prob=0.25,
+            torn_tail_prob=0.3,
+            lost_suffix_span=3,
+            drop_prob=0.2,
+            crash_prob=0.5,
+            crash_period=16,
+            crash_down_ticks=8,
+        ),
+        18,
+        id="n5-durable-crashes",  # the storage plane vs the oracle under
+        # crash churn: fsync watermark advance (with jitter stalls), the ack
+        # clamp + durable leader self-match, the vote-exposure gate with its
+        # late-grant completion responses, and crash recovery truncating the
+        # torn un-fsynced suffix back to the durable floor
+    ),
+    pytest.param(
+        RaftConfig(
+            n_nodes=5,
+            log_capacity=8,
+            max_entries_per_rpc=2,
+            client_interval=1,
+            fsync_interval=4,
+            fsync_jitter_prob=0.3,
+            torn_tail_prob=0.4,
+            lost_suffix_span=4,
+            pre_vote=True,
+            drop_prob=0.25,
+            crash_prob=0.5,
+            crash_period=14,
+            crash_down_ticks=8,
+            compact_planes=True,
+        ),
+        19,
+        id="n5-durable-prevote-compact",  # durability x pre_vote x the
+        # compacted carry layout: late-grant responses racing prevote
+        # promotions and AE responses on the same edges, recovery truncation
+        # of logs carried bit-packed, narrow-RPC catch-up after torn tails
+    ),
 ]
 
 

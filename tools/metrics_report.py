@@ -347,7 +347,8 @@ def report_measurement(path: str, out=None) -> None:
     print("\n  A/B deltas:", file=out)
     ab = doc.get("ab") or {}
     for key in ("fault_lattice", "serve_offer_plane",
-                "layout_dense_vs_compact", "transfer_during_joint"):
+                "layout_dense_vs_compact", "durability",
+                "transfer_during_joint"):
         arm = ab.get(key) or {}
         ratio = arm.get("on_over_off_ticks_per_s")
         print(f"  {key:18} on/off throughput ratio: {_fmt(ratio)} "
