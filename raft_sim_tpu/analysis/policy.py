@@ -74,12 +74,16 @@ CONCRETE_DTYPES = ("bool", "int8", "int16", "int32", "int64", "uint8", "uint32")
 POLICY_DTYPES = ("index_dtype", "ack_dtype", "node_dtype")
 
 # Leading-comment grammar: optional shape (`[N, W]` / `scalar`), one or more
-# dtype tokens separated by `/`, optionally a parenthesized policy name, then
-# free prose. Examples that must parse (all live in types.py today):
+# dtype tokens separated by `/`, optionally a parenthesized policy name,
+# optionally a VALUE-RANGE clause `in [lo, hi]` (closed interval; lo/hi are
+# integer expressions over the config symbols below -- the value-range audit
+# seeds its abstract interpreter from these and proves them inductive, rules
+# range-annotation-stale / range-pack-width), then free prose. Examples that
+# must parse (all live in types.py today):
 #   # [N] int32 (starts at 1, core.clj:34)
 #   # [N, W] uint32; bit j of votes[i] = i holds a vote from j
-#   # [N, N] index_dtype; leader i's next index for peer j
-#   # [N(responder)] int16/int32 (index_dtype): acked index ...
+#   # [N, N] index_dtype in [1, cap+1]; leader i's next index for peer j
+#   # [N(responder)] int16/int32 (index_dtype) in [0, cap]: acked index ...
 #   # scalar int32 global tick counter
 #   # bool: two leaders share a term
 _DTYPE_TOKEN = "|".join(CONCRETE_DTYPES + POLICY_DTYPES)
@@ -87,7 +91,112 @@ _COMMENT_RE = re.compile(
     r"^(?:\[(?P<shape>[^\]]*)\]|(?P<scalar>scalar))?\s*"
     rf"(?P<dtypes>(?:{_DTYPE_TOKEN})(?:/(?:{_DTYPE_TOKEN}))*)"
     rf"(?:\s*\((?P<policy>{'|'.join(POLICY_DTYPES)})\))?"
+    r"(?:\s+in\s+\[(?P<lo>[^,\[\]]+),\s*(?P<hi>[^,\[\]]+)\])?"
 )
+
+# Symbols legal in a range clause, resolved per config. Kept deliberately
+# small: the bounds that motivate the narrow-dtype policy (tile.py widths are
+# functions of exactly cap/sat/E).
+def _range_symbols(cfg: RaftConfig) -> dict[str, int]:
+    return {
+        "cap": cfg.log_capacity,
+        "sat": cfg.ack_age_sat,
+        "E": cfg.max_entries_per_rpc,
+        "N": cfg.n_nodes,
+        "K": cfg.client_pipeline,
+        "NIL": rst_types.NIL,
+    }
+
+
+_RANGE_SYMBOLS = ("cap", "sat", "E", "N", "K", "NIL")
+
+
+def parse_range_expr(expr: str) -> ast.expr:
+    """Validate a range-clause bound: integer arithmetic (+ - *) over int
+    literals and the config symbols. Returns the parsed AST; raises
+    ValueError (with the offending token) on anything else."""
+    try:
+        node = ast.parse(expr.strip(), mode="eval").body
+    except SyntaxError as e:
+        raise ValueError(f"range bound {expr!r} is not an expression: {e}")
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.BinOp):
+            if not isinstance(sub.op, (ast.Add, ast.Sub, ast.Mult)):
+                raise ValueError(f"range bound {expr!r}: operator not in + - *")
+        elif isinstance(sub, ast.UnaryOp):
+            if not isinstance(sub.op, ast.USub):
+                raise ValueError(f"range bound {expr!r}: unary op not -")
+        elif isinstance(sub, ast.Constant):
+            if not isinstance(sub.value, int):
+                raise ValueError(f"range bound {expr!r}: non-integer literal")
+        elif isinstance(sub, ast.Name):
+            if sub.id not in _RANGE_SYMBOLS:
+                raise ValueError(
+                    f"range bound {expr!r}: unknown symbol {sub.id!r} "
+                    f"(legal: {', '.join(_RANGE_SYMBOLS)})"
+                )
+        elif not isinstance(sub, (ast.Add, ast.Sub, ast.Mult, ast.USub, ast.Load)):
+            raise ValueError(f"range bound {expr!r}: {type(sub).__name__} not allowed")
+    return node
+
+
+def resolve_range_expr(expr: str, cfg: RaftConfig) -> int:
+    """Evaluate a validated range bound under `cfg`'s symbol values."""
+    node = parse_range_expr(expr)
+    syms = _range_symbols(cfg)
+
+    def ev(n):
+        if isinstance(n, ast.Constant):
+            return n.value
+        if isinstance(n, ast.Name):
+            return syms[n.id]
+        if isinstance(n, ast.UnaryOp):
+            return -ev(n.operand)
+        ops = {ast.Add: lambda a, b: a + b, ast.Sub: lambda a, b: a - b,
+               ast.Mult: lambda a, b: a * b}
+        return ops[type(n.op)](ev(n.left), ev(n.right))
+
+    return ev(node)
+
+
+# Capacity-bounded range declarations are the NON-compaction contract: with
+# cfg.compaction the same legs carry absolute 1-based indices with no static
+# bound (types.index_dtype widens them to int32 for the same reason), so the
+# audit neither seeds nor checks these clauses there. Names in carry-leaf
+# convention (state bare, mailbox `mb.<f>`).
+CAPACITY_RANGE_LEGS = frozenset({
+    "next_index", "match_index", "commit_index", "log_len", "dur_len",
+    "mb.a_match", "mb.a_hint", "mb.ent_start",
+})
+
+
+def range_applies(leg: str, cfg: RaftConfig) -> bool:
+    """Whether `leg`'s declared range clause is in force under `cfg`."""
+    if leg in CAPACITY_RANGE_LEGS and cfg.compaction:
+        return False
+    return True
+
+
+def declared_ranges(cfg: RaftConfig, specs=None) -> dict[str, tuple[int, int]]:
+    """Carry-leg name -> (lo, hi) resolved declared range under `cfg`, for
+    every types.py field whose comment carries a range clause that is in
+    force (`range_applies`). The value-range audit seeds scan carries from
+    this and proves each clause inductive."""
+    if specs is None:
+        specs, _problems = parse_types_comments()
+    out: dict[str, tuple[int, int]] = {}
+    for cls, prefix in (("ClusterState", ""), ("Mailbox", "mb.")):
+        for f, spec in specs.get(cls, {}).items():
+            if spec.lo is None:
+                continue
+            leg = prefix + f
+            if not range_applies(leg, cfg):
+                continue
+            out[leg] = (
+                resolve_range_expr(spec.lo, cfg),
+                resolve_range_expr(spec.hi, cfg),
+            )
+    return out
 # Optional `= <default>` between the annotation and the comment: StepInputs'
 # reconfiguration-plane fields default to the Python-int NIL sentinel so
 # hand-built test inputs stay valid (types.py).
@@ -95,17 +204,22 @@ _FIELD_RE = re.compile(r"^\s*(\w+):\s*jax\.Array(?:\s*=\s*[\w.+-]+)?\s*#\s*(.*)$
 
 
 class FieldSpec:
-    """One parsed field-comment contract: declared ndim (None = unchecked)
-    and the set of dtype tokens the comment admits."""
+    """One parsed field-comment contract: declared ndim (None = unchecked),
+    the set of dtype tokens the comment admits, and the optional declared
+    value range (lo/hi bound expressions, None = undeclared)."""
 
-    def __init__(self, name: str, line: int, ndim: int | None, dtypes: tuple[str, ...]):
+    def __init__(self, name: str, line: int, ndim: int | None, dtypes: tuple[str, ...],
+                 lo: str | None = None, hi: str | None = None):
         self.name = name
         self.line = line
         self.ndim = ndim
         self.dtypes = dtypes
+        self.lo = lo
+        self.hi = hi
 
     def __repr__(self):  # test/debug readability only
-        return f"FieldSpec({self.name!r}, ndim={self.ndim}, dtypes={self.dtypes})"
+        rng = f", in=[{self.lo}, {self.hi}]" if self.lo is not None else ""
+        return f"FieldSpec({self.name!r}, ndim={self.ndim}, dtypes={self.dtypes}{rng})"
 
 
 def parse_types_comments(source: str | None = None):
@@ -150,7 +264,22 @@ def parse_types_comments(source: str | None = None):
             dtypes = tuple(cm.group("dtypes").split("/"))
             if cm.group("policy"):
                 dtypes = dtypes + (cm.group("policy"),)
-            fields[name] = FieldSpec(name, lineno, ndim, dtypes)
+            lo, hi = cm.group("lo"), cm.group("hi")
+            if lo is not None:
+                try:
+                    parse_range_expr(lo)
+                    parse_range_expr(hi)
+                except ValueError as e:
+                    problems.append((lineno, f"{node.name}.{name}: {e}"))
+                    lo = hi = None
+            elif comment.strip()[cm.end():].lstrip().startswith("in ["):
+                # A malformed range clause must be a finding, not silently
+                # demoted to prose (closed `[lo, hi]` with exactly one comma).
+                problems.append(
+                    (lineno, f"{node.name}.{name}: range clause in comment "
+                             f"{comment!r} does not parse as `in [lo, hi]`")
+                )
+            fields[name] = FieldSpec(name, lineno, ndim, dtypes, lo=lo, hi=hi)
         out[node.name] = fields
     return out, problems
 

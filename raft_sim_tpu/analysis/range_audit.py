@@ -1,0 +1,1303 @@
+"""Pass E -- value-range abstract interpretation over the lowered kernels.
+
+An interval abstract interpreter runs over the SAME lowered jaxprs Pass A
+audits (`jaxpr_audit.programs`, shared lru-cached lowerings): every integer
+leg carries an interval `[lo, hi]`, seeded from config bounds and the
+types.py range clauses (`policy.declared_ranges`), and propagated through
+the integer op vocabulary of the kernels. Scan carries run a widening fixed
+point: legs that stabilize are PROVEN inductive invariants; legs that grow
+at a constant measured rate (term, commit totals, metric accumulators) get
+a pinned safe horizon -- the tick count before their dtype wraps; anything
+else widens to dtype-top, tainted.
+
+The rules (docs/ANALYSIS.md has the catalogue and the legit-range-change
+workflow):
+
+- range-dtype-overflow  -- a proven interval exceeds the leg's dtype, or a
+  narrowing `astype` whose fit is unproven. Unsigned planes are modular by
+  design (RNG words) and never fire; tainted (audit-horizon-widened) int32+
+  values are the horizon machinery's jurisdiction and are exempt here.
+- range-pack-width      -- the compact layout's planes must fit the
+  `ops/tile.pack_width_table` widths (single-sourced: tile.py's plans, this
+  pass, and tests/oracle.py's independent restatement read one table).
+- range-index-oob       -- a gather/scatter lowered with
+  PROMISE_IN_BOUNDS whose index interval is not proven inside the operand
+  extents. Clip idioms (max/min on the index) are interval-precise, so an
+  explicitly clipped index discharges the proof; dynamic_slice clamps by
+  lax semantics and never fires.
+- range-annotation-stale -- a declared range not implied by the computed
+  interval (the one-tick image escapes it, or it could not be proven
+  inductive), or wildly looser than the proven interval.
+- range-horizon         -- a monotone PROTOCOL leg (state/mailbox; metric
+  and trace accumulators are pinned as diagnostics but not gated -- their
+  overflow corrupts telemetry, not trajectories) whose wrap horizon is
+  below the 10M-tick soak budget.
+- range-golden          -- the meta-rule, mirroring Pass C's cost-golden: a
+  missing golden file, a pin drift against tests/golden_ranges.json, or a
+  failed derivation. A program whose ranges cannot be derived fires a
+  VISIBLE "gates NOT being checked" finding instead of silently skipping.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+import jax
+import numpy as np
+
+from raft_sim_tpu.analysis import jaxpr_audit, policy
+from raft_sim_tpu.analysis.findings import Finding
+from raft_sim_tpu.utils.config import PRESETS, RaftConfig
+
+try:  # jax >= 0.4.36 exposes the stable alias
+    from jax.extend import core as _jcore
+except ImportError:  # pragma: no cover
+    from jax import core as _jcore
+
+_Literal = _jcore.Literal
+
+RULES = frozenset({
+    "range-dtype-overflow",
+    "range-pack-width",
+    "range-index-oob",
+    "range-annotation-stale",
+    "range-horizon",
+    "range-golden",
+})
+
+#: The soak budget a monotone protocol leg must survive (docs/PERF.md).
+SOAK_TICKS = 10_000_000
+#: Audited horizon: widened monotone legs are valued at 2x the soak budget,
+#: so arithmetic DOWNSTREAM of a widened leg is checked with soak headroom.
+H_AUDIT = 2 * SOAK_TICKS
+#: Horizons are capped here so the golden stays readable (a leg that wraps
+#: after 1e12 ticks is "never" at any plausible tick rate).
+HORIZON_CAP = 10**12
+#: Widening fixed-point iterations for the audited tick loop (rate
+#: measurement needs >= 3 history points) / for generic outer loops.
+MAX_ITERS = 4
+MAX_ITERS_GENERIC = 2
+#: A declared range is "wildly looser" than the proven interval when its
+#: width exceeds LOOSE_FACTOR x the proven width plus LOOSE_SLACK.
+LOOSE_FACTOR = 4
+LOOSE_SLACK = 8
+
+DEFAULT_TOLERANCE = {"horizon_rel": 0.0}
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REGEN = "regenerate with `python tools/check.py --update-goldens` if intended"
+
+
+def golden_path() -> str:
+    return os.path.join(_REPO_ROOT, "tests", "golden_ranges.json")
+
+
+# ----------------------------------------------------------- interval domain
+#
+# An abstract value is `(lo, hi, taint)`: lo/hi are Python ints or None
+# (None = unbounded / non-integer, e.g. RNG key planes), taint marks values
+# derived from an audit-horizon widening (downstream overflow findings on
+# int32+ are suppressed for tainted values -- the horizon rule owns them).
+
+_TOP = (None, None, False)
+
+
+def _dtype_bounds(dtype):
+    try:
+        dtype = np.dtype(dtype)
+    except TypeError:
+        return None
+    if dtype == np.bool_:
+        return (0, 1)
+    if dtype.kind in ("i", "u"):
+        ii = np.iinfo(dtype)
+        return (int(ii.min), int(ii.max))
+    return None
+
+
+def _aval_bounds(aval):
+    dt = getattr(aval, "dtype", None)
+    return None if dt is None else _dtype_bounds(dt)
+
+
+def _top(aval):
+    # True unknown: dtype bounds are NOT materialized as known values --
+    # arithmetic over unknowns must stay unknown, or every add of two
+    # unseeded int32 planes would "prove" an overflow.
+    return _TOP
+
+
+def _join(a, b):
+    lo = None if a[0] is None or b[0] is None else min(a[0], b[0])
+    hi = None if a[1] is None or b[1] is None else max(a[1], b[1])
+    return (lo, hi, a[2] or b[2])
+
+
+def _join_all(vals):
+    return functools.reduce(_join, vals) if vals else _TOP
+
+
+def _known(*vals):
+    return all(v[0] is not None and v[1] is not None for v in vals)
+
+
+def _const_iv(x):
+    arr = np.asarray(x)
+    if arr.dtype == np.bool_:
+        arr = arr.astype(np.int32)
+    if arr.dtype.kind not in ("i", "u"):
+        return _TOP
+    if arr.size == 0:
+        return (0, 0, False)
+    return (int(arr.min()), int(arr.max()), False)
+
+
+def _corners(a, b, op):
+    t = a[2] or b[2]
+    if not _known(a, b):
+        return (None, None, t)
+    vals = [op(x, y) for x in (a[0], a[1]) for y in (b[0], b[1])]
+    return (min(vals), max(vals), t)
+
+
+def _fmt(v):
+    lo = "?" if v[0] is None else v[0]
+    hi = "?" if v[1] is None else v[1]
+    return f"[{lo}, {hi}]"
+
+
+def _protocol_leg(name: str) -> bool:
+    """Horizon-GATED legs: the protocol state/mailbox planes. Metric/trace
+    accumulators and auxiliary legs are pinned in the golden as diagnostics
+    but not gated (their wrap corrupts telemetry, not trajectories)."""
+    return not name.startswith(("metric.", "trace.", "extra")) and name != "first_viol"
+
+
+def _trunc_div(a, b):
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+def _src(eqn) -> str:
+    """The user-frame source location of an eqn -- findings must name the
+    kernel line, not just the program."""
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:
+        return "?"
+
+
+# ------------------------------------------------------------ the interpreter
+
+
+class _Interp:
+    """One abstract-interpretation run over one lowered program. Holds the
+    findings sink, the declared ranges to seed/check, and the identity of
+    the TARGET scan (the tick loop with `target_nk` carry legs, named by
+    `leg_names`); every other scan gets the generic widening treatment."""
+
+    def __init__(self, program, cfg, *, declared, leg_names, target_nk,
+                 invariant, findings):
+        self.program = program
+        self.cfg = cfg
+        self.declared = declared or {}
+        self.leg_names = leg_names or []
+        self.target_nk = target_nk
+        self.invariant = invariant or set()
+        self.findings = findings
+        self.report = True
+        self.scan_record = None
+        self.loop_depth = 0  # nesting: target scan re-entered from an outer
+        # loop sees widened (not initial) carries -- init checks only at 0
+        self.parts = {}  # concatenate outvar -> per-operand intervals
+        self._seen = set()
+
+    def emit(self, rule, message):
+        if not self.report:
+            return
+        key = (rule, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(rule=rule, path=self.program, message=message))
+
+    # ---- evaluation core
+
+    def eval_closed(self, closed, args):
+        env = {}
+        for v, c in zip(closed.jaxpr.constvars, closed.consts):
+            env[v] = _const_iv(c)
+        return self.eval_jaxpr(closed.jaxpr, args, env)
+
+    def eval_jaxpr(self, jaxpr, args, env=None):
+        env = {} if env is None else env
+        for v, a in zip(jaxpr.invars, args):
+            env[v] = a
+        for eqn in jaxpr.eqns:
+            self.eval_eqn(eqn, env)
+        return [self.read(env, a) for a in jaxpr.outvars]
+
+    def read(self, env, atom):
+        if isinstance(atom, _Literal):
+            return _const_iv(atom.val)
+        v = env.get(atom)
+        return _top(atom.aval) if v is None else v
+
+    def eval_eqn(self, eqn, env):
+        prim = eqn.primitive.name
+        ins = [self.read(env, a) for a in eqn.invars]
+        if prim == "scan":
+            outs = self._scan(eqn, ins)
+        else:
+            handler = getattr(self, "_p_" + prim.replace("-", "_"), None)
+            if handler is not None:
+                outs = handler(eqn, ins)
+            elif "call_jaxpr" in eqn.params and hasattr(eqn.params["call_jaxpr"], "jaxpr"):
+                outs = self.eval_closed(eqn.params["call_jaxpr"], ins)
+            else:
+                # Unknown primitive: unknown output is sound; taint
+                # propagates so a widened leg keeps its horizon exemption.
+                taint = any(v[2] for v in ins)
+                outs = [(None, None, taint) for _ in eqn.outvars]
+        if not isinstance(outs, list):
+            outs = [outs]
+        for o, val in zip(eqn.outvars, outs):
+            env[o] = self._fit(val, o.aval, eqn)
+
+    def _fit(self, val, aval, eqn):
+        """Dtype admission: emit range-dtype-overflow when a PROVEN signed
+        interval escapes the output dtype, then drop to UNKNOWN (a wrapped
+        value reaches anywhere in the dtype, and unknownness stops one wrap
+        point from cascading into a finding per downstream op). Unsigned
+        and bool planes are modular by design; tainted int32+ values are
+        the horizon rule's jurisdiction."""
+        b = _aval_bounds(aval)
+        if b is None:
+            return val
+        lo, hi, t = val
+        escapes = (lo is not None and lo < b[0]) or (hi is not None and hi > b[1])
+        if not escapes:
+            return val
+        if b[0] < 0 and not (t and np.dtype(aval.dtype).itemsize >= 4):
+            self.emit(
+                "range-dtype-overflow",
+                f"{eqn.primitive.name}: proven interval {_fmt(val)} exceeds "
+                f"{aval.dtype} [{b[0]}, {b[1]}] at {_src(eqn)}",
+            )
+        if t:
+            return (lo, hi, t)  # keep the ideal value for rate measurement
+        return (None, None, t)
+
+    # ---- arithmetic / comparison handlers
+
+    def _p_add(self, eqn, ins):
+        a, b = ins
+        t = a[2] or b[2]
+        lo = None if a[0] is None or b[0] is None else a[0] + b[0]
+        hi = None if a[1] is None or b[1] is None else a[1] + b[1]
+        return [(lo, hi, t)]
+
+    def _p_sub(self, eqn, ins):
+        a, b = ins
+        t = a[2] or b[2]
+        lo = None if a[0] is None or b[1] is None else a[0] - b[1]
+        hi = None if a[1] is None or b[0] is None else a[1] - b[0]
+        return [(lo, hi, t)]
+
+    def _p_mul(self, eqn, ins):
+        return [_corners(ins[0], ins[1], lambda x, y: x * y)]
+
+    def _p_neg(self, eqn, ins):
+        a = ins[0]
+        lo = None if a[1] is None else -a[1]
+        hi = None if a[0] is None else -a[0]
+        return [(lo, hi, a[2])]
+
+    def _p_abs(self, eqn, ins):
+        a = ins[0]
+        if not _known(a):
+            return [(0, None, a[2])]
+        if a[0] >= 0:
+            return [a]
+        lo = 0 if a[0] <= 0 <= a[1] else min(abs(a[0]), abs(a[1]))
+        return [(lo, max(abs(a[0]), abs(a[1])), a[2])]
+
+    def _p_sign(self, eqn, ins):
+        a = ins[0]
+        if _known(a):
+            lo = -1 if a[0] < 0 else (0 if a[0] == 0 else 1)
+            hi = 1 if a[1] > 0 else (0 if a[1] == 0 else -1)
+            return [(lo, hi, a[2])]
+        return [(-1, 1, a[2])]
+
+    def _p_max(self, eqn, ins):
+        a, b = ins
+        t = a[2] or b[2]
+        los = [x for x in (a[0], b[0]) if x is not None]
+        lo = max(los) if los else None  # max(a,b) >= each known lower bound
+        hi = None if a[1] is None or b[1] is None else max(a[1], b[1])
+        return [(lo, hi, t)]
+
+    def _p_min(self, eqn, ins):
+        a, b = ins
+        t = a[2] or b[2]
+        his = [x for x in (a[1], b[1]) if x is not None]
+        hi = min(his) if his else None
+        lo = None if a[0] is None or b[0] is None else min(a[0], b[0])
+        return [(lo, hi, t)]
+
+    def _p_clamp(self, eqn, ins):
+        lo_b, x, hi_b = ins
+        m = self._p_min(eqn, [x, hi_b])[0]
+        return self._p_max(eqn, [lo_b, m])
+
+    def _p_div(self, eqn, ins):
+        a, b = ins
+        t = a[2] or b[2]
+        if not _known(a, b) or b[0] <= 0 <= b[1]:
+            return [(None, None, t)]
+        vals = [_trunc_div(x, y) for x in (a[0], a[1]) for y in (b[0], b[1])]
+        return [(min(vals), max(vals), t)]
+
+    def _p_rem(self, eqn, ins):
+        a, b = ins
+        t = a[2] or b[2]
+        if not _known(b):
+            return [(None, None, t)]
+        m = max(abs(b[0]), abs(b[1]))
+        if m == 0:
+            return [(None, None, t)]
+        # lax.rem: sign follows the dividend, magnitude < |divisor|.  An
+        # unsigned dividend is non-negative even when its interval is unknown
+        # (jax.random.randint's modulo chain runs on uint32 random bits).
+        nonneg = (a[0] is not None and a[0] >= 0) or not np.issubdtype(
+            np.dtype(eqn.outvars[0].aval.dtype), np.signedinteger
+        )
+        lo = 0 if nonneg else -(m - 1)
+        hi = 0 if (a[1] is not None and a[1] <= 0) else m - 1
+        if _known(a) and a[0] >= 0:
+            hi = min(hi, a[1])
+        return [(lo, hi, t)]
+
+    def _cmp(self, eqn, ins, true_if, false_if):
+        """Comparison with static resolution: a provably-constant predicate
+        lets select_n collapse to one branch -- which is what discharges
+        jax's negative-index normalization (`select(i < 0, i + N, i)`)
+        whenever the index is proven non-negative."""
+        a, b = ins
+        t = a[2] or b[2]
+        if _known(a, b):
+            if true_if(a, b):
+                return [(1, 1, t)]
+            if false_if(a, b):
+                return [(0, 0, t)]
+        return [(0, 1, t)]
+
+    def _p_lt(self, eqn, ins):
+        return self._cmp(eqn, ins, lambda a, b: a[1] < b[0],
+                         lambda a, b: a[0] >= b[1])
+
+    def _p_le(self, eqn, ins):
+        return self._cmp(eqn, ins, lambda a, b: a[1] <= b[0],
+                         lambda a, b: a[0] > b[1])
+
+    def _p_gt(self, eqn, ins):
+        return self._cmp(eqn, ins, lambda a, b: a[0] > b[1],
+                         lambda a, b: a[1] <= b[0])
+
+    def _p_ge(self, eqn, ins):
+        return self._cmp(eqn, ins, lambda a, b: a[0] >= b[1],
+                         lambda a, b: a[1] < b[0])
+
+    def _p_eq(self, eqn, ins):
+        return self._cmp(eqn, ins,
+                         lambda a, b: a[0] == a[1] == b[0] == b[1],
+                         lambda a, b: a[1] < b[0] or a[0] > b[1])
+
+    def _p_ne(self, eqn, ins):
+        return self._cmp(eqn, ins,
+                         lambda a, b: a[1] < b[0] or a[0] > b[1],
+                         lambda a, b: a[0] == a[1] == b[0] == b[1])
+
+    # ---- bitwise / shift handlers
+
+    def _bitop(self, eqn, ins, kind):
+        a, b = ins
+        t = a[2] or b[2]
+        if _known(a, b) and a[0] >= 0 and b[0] >= 0:
+            if kind == "and":
+                return [(0, min(a[1], b[1]), t)]
+            bl = max(a[1], b[1]).bit_length()
+            return [(0, (1 << bl) - 1, t)]
+        return [(None, None, t)]
+
+    def _p_and(self, eqn, ins):
+        return self._bitop(eqn, ins, "and")
+
+    def _p_or(self, eqn, ins):
+        return self._bitop(eqn, ins, "or")
+
+    def _p_xor(self, eqn, ins):
+        return self._bitop(eqn, ins, "or")
+
+    def _p_not(self, eqn, ins):
+        a = ins[0]
+        b = _aval_bounds(eqn.outvars[0].aval)
+        if b is None or not _known(a):
+            return [(None, None, a[2])]
+        if b == (0, 1):
+            return [(1 - a[1], 1 - a[0], a[2])]
+        if b[0] < 0:  # signed: ~x == -1 - x
+            return [(-1 - a[1], -1 - a[0], a[2])]
+        return [(b[1] - a[1], b[1] - a[0], a[2])]  # unsigned complement
+
+    def _p_shift_left(self, eqn, ins):
+        a, s = ins
+        t = a[2] or s[2]
+        if not _known(a, s) or s[0] < 0:
+            return [(None, None, t)]
+        vals = [x << y for x in (a[0], a[1]) for y in (s[0], s[1])]
+        return [(min(vals), max(vals), t)]
+
+    def _p_shift_right_logical(self, eqn, ins):
+        a, s = ins
+        t = a[2] or s[2]
+        if _known(a, s) and a[0] >= 0 and s[0] >= 0:
+            return [(a[0] >> s[1], a[1] >> s[0], t)]
+        return [(None, None, t)]
+
+    def _p_shift_right_arithmetic(self, eqn, ins):
+        a, s = ins
+        t = a[2] or s[2]
+        if _known(a, s) and s[0] >= 0:
+            vals = [x >> y for x in (a[0], a[1]) for y in (s[0], s[1])]
+            return [(min(vals), max(vals), t)]
+        return [(None, None, t)]
+
+    def _p_population_count(self, eqn, ins):
+        a = ins[0]
+        bits = np.dtype(eqn.invars[0].aval.dtype).itemsize * 8
+        hi = bits
+        if _known(a) and a[0] >= 0:
+            hi = a[1].bit_length()
+        return [(0, hi, a[2])]
+
+    # ---- structural handlers
+
+    def _identity(self, eqn, ins):
+        n = len(eqn.outvars)
+        return [ins[0]] * n if len(ins) == 1 else list(ins[:n])
+
+    _p_broadcast_in_dim = _identity
+    _p_reshape = _identity
+    _p_transpose = _identity
+    _p_squeeze = _identity
+    _p_slice = _identity
+    _p_rev = _identity
+    _p_copy = _identity
+    _p_device_put = _identity
+    _p_cummax = _identity
+    _p_cummin = _identity
+    _p_sort = _identity
+    _p_stop_gradient = _identity
+    _p_reduce_precision = _identity
+    _p_optimization_barrier = _identity
+
+    def _p_concatenate(self, eqn, ins):
+        # Remember the per-operand intervals: a multi-component gather index
+        # tensor is built by concatenating its components along the last
+        # axis, and the joint interval would mix (wide) slot indices into
+        # the (narrow) node-index bound check.
+        if eqn.params.get("dimension") == eqn.outvars[0].aval.ndim - 1:
+            self.parts[eqn.outvars[0]] = list(ins)
+        return [_join_all(ins)]
+
+    def _p_pad(self, eqn, ins):
+        return [_join(ins[0], ins[1])]
+
+    def _p_select_n(self, eqn, ins):
+        # A predicate proven constant selects exactly one branch.  This pairs
+        # with the static comparison handlers to see through jax's
+        # negative-index normalization instead of joining `i` with `i + N`.
+        p = ins[0]
+        if p[0] is not None and p[0] == p[1] and 0 <= p[0] < len(ins) - 1:
+            case = ins[1 + p[0]]
+            return [(case[0], case[1], case[2] or p[2])]
+        return [_join_all(ins[1:])]
+
+    def _p_iota(self, eqn, ins):
+        shape = eqn.params["shape"]
+        dim = eqn.params["dimension"]
+        n = shape[dim] if shape else 0
+        return [(0, max(n - 1, 0), False)]
+
+    def _p_convert_element_type(self, eqn, ins):
+        v = ins[0]
+        out_aval = eqn.outvars[0].aval
+        b = _aval_bounds(out_aval)
+        if b is None or not _known(v):
+            return [(None, None, v[2])]
+        if v[0] < b[0] or v[1] > b[1]:
+            if b[0] < 0 and not (v[2] and np.dtype(out_aval.dtype).itemsize >= 4):
+                self.emit(
+                    "range-dtype-overflow",
+                    f"narrowing astype to {out_aval.dtype}: fit unproven for "
+                    f"interval {_fmt(v)} (source {eqn.invars[0].aval.dtype}) "
+                    f"at {_src(eqn)}",
+                )
+            if v[2]:
+                return [v]
+            return [(None, None, v[2])]
+        return [v]
+
+    # ---- reductions
+
+    def _reduced_n(self, eqn):
+        shape = getattr(eqn.invars[0].aval, "shape", ())
+        axes = eqn.params.get("axes", ())
+        n = 1
+        for a in axes:
+            n *= shape[a]
+        return n
+
+    def _p_reduce_sum(self, eqn, ins):
+        a = ins[0]
+        n = self._reduced_n(eqn)
+        if not _known(a):
+            return [(None, None, a[2])]
+        lo = min(0, a[0] * n)
+        hi = max(0, a[1] * n)
+        return [(lo, hi, a[2])]
+
+    def _p_reduce_max(self, eqn, ins):
+        return [ins[0]]
+
+    _p_reduce_min = _p_reduce_max
+
+    def _p_reduce_or(self, eqn, ins):
+        a = ins[0]
+        if _known(a) and a[0] >= 0:
+            return [(0, (1 << a[1].bit_length()) - 1, a[2])]
+        return [(None, None, a[2])]
+
+    def _p_reduce_and(self, eqn, ins):
+        a = ins[0]
+        if _known(a) and a[0] >= 0:
+            return [(0, a[1], a[2])]
+        return [(None, None, a[2])]
+
+    def _p_cumsum(self, eqn, ins):
+        a = ins[0]
+        shape = getattr(eqn.invars[0].aval, "shape", ())
+        axis = eqn.params.get("axis", 0)
+        n = shape[axis] if shape else 1
+        if not _known(a):
+            return [(None, None, a[2])]
+        return [(min(a[0], a[0] * n), max(a[1], a[1] * n), a[2])]
+
+    # ---- indexing
+
+    def _p_gather(self, eqn, ins):
+        operand, indices = ins[0], ins[1]
+        mode = eqn.params.get("mode")
+        if mode is not None and "PROMISE_IN_BOUNDS" in str(mode):
+            comps = self.parts.get(eqn.invars[1])
+            self._oob_check(
+                "gather",
+                eqn,
+                eqn.invars[0].aval,
+                eqn.params["dimension_numbers"].start_index_map,
+                eqn.params["slice_sizes"],
+                indices,
+                comps,
+            )
+        if mode is not None and "FILL" in str(mode):
+            return [(None, None, operand[2] or indices[2])]
+        return [(operand[0], operand[1], operand[2] or indices[2])]
+
+    def _p_scatter(self, eqn, ins):
+        operand, indices, updates = ins[0], ins[1], ins[2]
+        mode = eqn.params.get("mode")
+        if mode is not None and "PROMISE_IN_BOUNDS" in str(mode):
+            dims = eqn.params["dimension_numbers"].scatter_dims_to_operand_dims
+            sizes = tuple(1 for _ in eqn.invars[0].aval.shape)  # slot extent
+            op_aval = eqn.invars[0].aval
+            comps = self.parts.get(eqn.invars[1])
+            self._oob_check("scatter", eqn, op_aval, dims, sizes, indices, comps)
+        return [_join(operand, updates)]
+
+    def _p_scatter_add(self, eqn, ins):
+        base = self._p_scatter(eqn, ins)[0]
+        return [_corners(base, ins[2], lambda x, y: x + y)]
+
+    _p_scatter_max = _p_scatter
+    _p_scatter_min = _p_scatter
+
+    def _p_dynamic_slice(self, eqn, ins):
+        return [ins[0]]  # start indices clamp by lax semantics: never oob
+
+    def _p_dynamic_update_slice(self, eqn, ins):
+        return [_join(ins[0], ins[1])]
+
+    def _oob_check(self, what, eqn, op_aval, dims, slice_sizes, idx_iv, comps):
+        if not self.report:
+            return
+        bounds = []
+        for d in dims:
+            # slice_sizes is per OPERAND DIM (full rank), not per index
+            # component: the valid start range for component -> dim d is
+            # [0, shape[d] - slice_sizes[d]].
+            size = slice_sizes[d] if d < len(slice_sizes) else 1
+            bounds.append(op_aval.shape[d] - size)
+        if not bounds:
+            return
+        if comps is not None and len(comps) == len(bounds):
+            # Component-precise: the index tensor was a last-axis
+            # concatenation of one plane per indexed operand dim.
+            for i, (c, bound) in enumerate(zip(comps, bounds)):
+                if not _known(c):
+                    self.emit(
+                        "range-index-oob",
+                        f"{what} with PROMISE_IN_BOUNDS but an unproven "
+                        f"index interval for component {i} (operand shape "
+                        f"{tuple(op_aval.shape)}) at {_src(eqn)}",
+                    )
+                elif c[0] < 0 or c[1] > bound:
+                    self.emit(
+                        "range-index-oob",
+                        f"{what} promises in-bounds indices but component "
+                        f"{i} has proven interval {_fmt(c)}, not within "
+                        f"[0, {bound}] (operand shape {tuple(op_aval.shape)}, "
+                        f"slice sizes {tuple(slice_sizes)}) at {_src(eqn)}",
+                    )
+            return
+        if not _known(idx_iv):
+            self.emit(
+                "range-index-oob",
+                f"{what} with PROMISE_IN_BOUNDS but an unproven index interval "
+                f"over operand shape {tuple(op_aval.shape)} at {_src(eqn)}",
+            )
+            return
+        # Single-component starts prove exactly; multi-component without
+        # recoverable components uses the weak (max-extent) bound --
+        # documented in docs/ANALYSIS.md.
+        bound = bounds[0] if len(bounds) == 1 else max(bounds)
+        if idx_iv[0] < 0 or idx_iv[1] > bound:
+            self.emit(
+                "range-index-oob",
+                f"{what} promises in-bounds indices but the proven interval "
+                f"{_fmt(idx_iv)} is not within [0, {bound}] (operand shape "
+                f"{tuple(op_aval.shape)}, slice sizes {tuple(slice_sizes)}) "
+                f"at {_src(eqn)}",
+            )
+
+    # ---- control flow
+
+    def _p_pjit(self, eqn, ins):
+        return self.eval_closed(eqn.params["jaxpr"], ins)
+
+    def _p_cond(self, eqn, ins):
+        branches = eqn.params["branches"]
+        ops = list(ins[1:])
+        results = [self.eval_closed(br, list(ops)) for br in branches]
+        return [_join_all(list(vals)) for vals in zip(*results)]
+
+    def _p_while(self, eqn, ins):
+        p = eqn.params
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        bconsts = ins[cn:cn + bn]
+        carry = list(ins[cn + bn:])
+        saved, self.report = self.report, False
+        cur = carry
+        self.loop_depth += 1
+        for _ in range(MAX_ITERS_GENERIC):
+            out = self.eval_closed(p["body_jaxpr"], list(bconsts) + cur)
+            nxt = [_join(a, b) for a, b in zip(cur, out)]
+            if all(n[:2] == c[:2] for n, c in zip(nxt, cur)):
+                cur = nxt
+                break
+            cur = nxt
+        else:
+            cur = [
+                (None, None, True) if n[:2] != c[:2] else c
+                for n, c in zip(nxt, carry)
+            ]
+        self.report = saved
+        out = self.eval_closed(p["body_jaxpr"], list(bconsts) + cur)
+        self.loop_depth -= 1
+        return [_join(a, b) for a, b in zip(cur, out)]
+
+    # ---- the scan protocol (the centerpiece)
+
+    def _scan(self, eqn, ins):
+        p = eqn.params
+        closed_body = p["jaxpr"]
+        nc, nk = p["num_consts"], p["num_carry"]
+        length = p.get("length") or 1
+        body = closed_body.jaxpr
+        consts, carry0, xs = list(ins[:nc]), list(ins[nc:nc + nk]), list(ins[nc + nk:])
+        carry_avals = [v.aval for v in body.invars[nc:nc + nk]]
+        dbounds = [_aval_bounds(a) for a in carry_avals]
+        is_target = self.target_nk is not None and nk == self.target_nk
+        names = self.leg_names if is_target else [f"leg{i}" for i in range(nk)]
+
+        entry = list(carry0)
+        if is_target:
+            for i, nm in enumerate(names):
+                d = self.declared.get(nm)
+                if d is None:
+                    continue
+                b = dbounds[i]
+                if b is not None and (d[0] < b[0] or d[1] > b[1]):
+                    self.emit(
+                        "range-dtype-overflow",
+                        f"carry leg `{nm}`: declared range [{d[0]}, {d[1]}] "
+                        f"does not fit its {carry_avals[i].dtype} plane "
+                        f"[{b[0]}, {b[1]}]",
+                    )
+                    entry[i] = (max(d[0], b[0]), min(d[1], b[1]), False)
+                    continue
+                c0 = carry0[i]
+                # Only a *known, top-level* initial interval can contradict
+                # the declaration: serve/trace tick loops are re-entered from
+                # an outer window scan whose carry already holds the widened
+                # per-window image, not the program's initial state.
+                if (self.loop_depth == 0 and _known(c0)
+                        and not (d[0] <= c0[0] and c0[1] <= d[1])):
+                    self.emit(
+                        "range-annotation-stale",
+                        f"carry leg `{nm}`: initial-value interval {_fmt(c0)} "
+                        f"is not within the declared range [{d[0]}, {d[1]}]",
+                    )
+                entry[i] = (d[0], d[1], False)
+
+        # Declared legs are PINNED at their declaration for the whole fixed
+        # point: the declaration is the trusted axiom (its one-tick overshoot
+        # is what the golden `escape` pin records), and letting an unprovable
+        # leg's join grow would leak -- e.g. log_len's guarded `+ do_write`
+        # would reclassify every leg derived from it as monotone.
+        pinned = [
+            is_target and self.declared.get(names[i]) is not None
+            for i in range(nk)
+        ]
+
+        # Widening fixed point (muted: iteration passes must not duplicate
+        # eqn-level findings; only the final pass reports).
+        saved, self.report = self.report, False
+        iters = MAX_ITERS if is_target else MAX_ITERS_GENERIC
+        hist = [[(v[0], v[1]) for v in entry]]
+        cur = list(entry)
+        image0 = None
+        self.loop_depth += 1
+        for _ in range(iters):
+            out = self._body_pass(closed_body, consts, cur, xs)[:nk]
+            if image0 is None:
+                image0 = out
+            nxt = [
+                e if pin else _join(a, b)
+                for pin, e, a, b in zip(pinned, entry, cur, out)
+            ]
+            hist.append([(v[0], v[1]) for v in nxt])
+            stable_all = all(n[:2] == c[:2] for n, c in zip(nxt, cur))
+            cur = nxt
+            if stable_all:
+                break
+
+        # Classify each leg: stable (proven invariant), monotone (constant
+        # measured growth rate -> safe horizon), or widened to dtype-top.
+        widened = list(cur)
+        legrec = []
+        for i in range(nk):
+            stable = hist[-1][i] == hist[-2][i]
+            rate = horizon = None
+            if not stable:
+                b = dbounds[i]
+                los = [row[i][0] for row in hist]
+                his = [row[i][1] for row in hist]
+                lo_ok = los[-1] is not None and los[-1] == los[-2]
+                d1 = (None if his[-1] is None or his[-2] is None
+                      else his[-1] - his[-2])
+                d2 = (None if len(his) < 3 or his[-2] is None or his[-3] is None
+                      else his[-2] - his[-3])
+                ent_hi = entry[i][1]
+                if (lo_ok and d1 is not None and d1 > 0 and d2 == d1
+                        and b is not None and ent_hi is not None):
+                    rate = d1
+                    horizon = min((b[1] - ent_hi) // rate, HORIZON_CAP)
+                    grow = H_AUDIT if is_target else length
+                    widened[i] = (los[-1], ent_hi + rate * grow, True)
+                else:
+                    widened[i] = (None, None, True)
+            legrec.append({"stable": stable, "rate": rate, "horizon": horizon})
+
+        # Final reporting pass over the widened carries.
+        self.report = saved
+        outs_full = self._body_pass(closed_body, consts, widened, xs)
+        self.loop_depth -= 1
+        final = [_join(w, o) for w, o in zip(widened, outs_full[:nk])]
+
+        if is_target and self.report:
+            # The escape/looseness checks compare declarations against the
+            # FINAL-pass image (body over the widened carries): the first
+            # muted pass still has undeclared legs at their init constants,
+            # which would make every dependent leg look artificially tight.
+            self._target_checks(names, entry, cur, outs_full[:nk], legrec,
+                                carry_avals, dbounds)
+        return final + list(outs_full[nk:])
+
+    def _body_pass(self, closed_body, consts, carry, xs):
+        return self.eval_closed(closed_body, list(consts) + list(carry) + list(xs))
+
+    def _target_checks(self, names, entry, cur, image0, legrec, carry_avals,
+                       dbounds):
+        record = {}
+        for i, nm in enumerate(names):
+            r = legrec[i]
+            d = self.declared.get(nm)
+            ent = {"dtype": str(carry_avals[i].dtype)}
+            if d is not None:
+                # Declared legs are seeded from the declaration, so the record
+                # pins the declaration plus the *escape*: how far the one-tick
+                # image provably leaves it.  Path-insensitive intervals cannot
+                # discharge masked-garbage idioms (a kernel computes junk that
+                # a downstream `where(ok, ...)` discards), so a nonzero escape
+                # is not a finding -- it is pinned in the golden and any DRIFT
+                # in it is.  escape null = image unknown (unprovable either
+                # way); no escape key = proven inductive.
+                ent["lo"], ent["hi"] = d[0], d[1]
+                iw = image0[i] if image0 is not None else _TOP
+                if _known(iw):
+                    esc = [min(0, iw[0] - d[0]), max(0, iw[1] - d[1])]
+                    if esc != [0, 0]:
+                        ent["escape"] = esc
+                    elif nm not in self.invariant:
+                        dw, cw = d[1] - d[0], iw[1] - iw[0]
+                        if dw > LOOSE_FACTOR * cw + LOOSE_SLACK:
+                            self.emit(
+                                "range-annotation-stale",
+                                f"carry leg `{nm}`: declared range [{d[0]}, "
+                                f"{d[1]}] is wildly looser than the proven "
+                                f"interval {_fmt(iw)}",
+                            )
+                else:
+                    ent["escape"] = None
+            elif r["stable"]:
+                ent["lo"], ent["hi"] = cur[i][0], cur[i][1]
+            elif r["rate"] is not None:
+                ent["lo"], ent["hi"] = entry[i][0], entry[i][1]
+                ent["rate"], ent["horizon"] = r["rate"], r["horizon"]
+            else:
+                b = dbounds[i]
+                ent["lo"], ent["hi"] = (b[0], b[1]) if b else (None, None)
+                ent["widened"] = True
+            record[nm] = ent
+
+            if (r["horizon"] is not None and r["horizon"] < SOAK_TICKS
+                    and _protocol_leg(nm)):
+                self.emit(
+                    "range-horizon",
+                    f"carry leg `{nm}` ({carry_avals[i].dtype}) grows by "
+                    f"{r['rate']}/tick from {entry[i][1]}: wraps after "
+                    f"~{r['horizon']:,} ticks, below the {SOAK_TICKS:,}-tick "
+                    f"soak budget",
+                )
+        if self.scan_record is None:
+            self.scan_record = record
+
+
+# -------------------------------------------------------------- program audit
+
+
+def _leg_names(kind: str) -> list[str]:
+    if kind == "trace_scan":
+        return policy.trace_carry_leaf_names()
+    names = list(policy.carry_leaf_names())
+    if kind == "serve_scan":
+        names.append("first_viol")
+    return names
+
+
+def _step_seed(closed, cfg: RaftConfig, declared):
+    """Map the declared ranges onto a step program's state invars (pytree
+    flatten order == policy.carry_leaf_names minus the metric legs). Returns
+    (args, ok): a mapping mismatch returns ok=False so the caller fires a
+    VISIBLE derivation-failure finding instead of mis-seeded checks."""
+    state_names = [n for n in policy.carry_leaf_names()
+                   if not n.startswith("metric.")]
+    invars = closed.jaxpr.invars
+    try:
+        state, inputs, _info = policy.state_avals(cfg)
+    except Exception:
+        return None, False
+    n_state = len(jax.tree_util.tree_leaves(state))
+    n_inputs = len(jax.tree_util.tree_leaves(inputs))
+    if len(state_names) != n_state or len(invars) != n_state + n_inputs:
+        return None, False
+    args = [_top(v.aval) for v in invars]
+    for i, nm in enumerate(state_names):
+        d = declared.get(nm)
+        if d is None:
+            continue
+        b = _aval_bounds(invars[i].aval)
+        if b is None or d[0] < b[0] or d[1] > b[1]:
+            continue  # the scan-side seeding names a dtype misfit per leg
+        args[i] = (d[0], d[1], False)
+    return args, True
+
+
+def audit_program(program: str, closed, kind: str, cfg: RaftConfig, *,
+                  declared=None, leg_names=None):
+    """Run the interval interpreter over one lowered program. Returns
+    (findings, scan_record): scan_record is the tick loop's per-leg record
+    (None for step programs or when no matching scan was found -- the
+    caller MUST turn that into a range-golden finding). `declared` and
+    `leg_names` are injectable for the seeded-negative tests."""
+    if declared is None:
+        declared = policy.declared_ranges(cfg)
+        # Packed tiers carry some legs as bit-packed words: a value-domain
+        # declaration must not seed (or pin) the packed plane -- the word
+        # ranges live in a different domain.  Their value ranges are checked
+        # by check_pack_widths against the tile table instead.
+        if getattr(cfg, "compact_planes", False):
+            from raft_sim_tpu.ops import tile
+
+            packed = {f for f, mode, *_ in tile.state_plan(cfg)
+                      if mode == "pack"}
+            packed |= {f"mb.{f}" for f, mode, *_ in tile.mailbox_plan(cfg)
+                       if mode == "pack"}
+            declared = {k: v for k, v in declared.items() if k not in packed}
+    findings: list[Finding] = []
+    invariant = policy.invariant_leaves(cfg)
+    if kind == "step":
+        interp = _Interp(program, cfg, declared=declared, leg_names=None,
+                         target_nk=None, invariant=invariant, findings=findings)
+        args, ok = _step_seed(closed, cfg, declared)
+        if not ok:
+            findings.append(Finding(
+                rule="range-golden", path=program,
+                message=(
+                    "step input mapping did not match the policy state "
+                    "template: the value-range gates for this program are "
+                    "NOT being checked"
+                ),
+            ))
+            return findings, None
+        interp.eval_closed(closed, args)
+        return findings, None
+    names = leg_names if leg_names is not None else _leg_names(kind)
+    interp = _Interp(program, cfg, declared=declared, leg_names=names,
+                     target_nk=len(names), invariant=invariant,
+                     findings=findings)
+    args = [_top(v.aval) for v in closed.jaxpr.invars]
+    interp.eval_closed(closed, args)
+    if interp.scan_record is None:
+        findings.append(Finding(
+            rule="range-golden", path=program,
+            message=(
+                f"no scan with the expected {len(names)}-leg carry found: "
+                f"the value-range gates for this program are NOT being checked"
+            ),
+        ))
+    return findings, interp.scan_record
+
+
+# ------------------------------------------------------- tier-level checks
+
+
+def check_pack_widths(cfg: RaftConfig, name: str, *, widths=None,
+                      declared=None) -> list[Finding]:
+    """range-pack-width: every compact-plane range must fit its allotted
+    bits after biasing, and a types.py declared range on the same leg must
+    agree with the table. `widths`/`declared` injectable for tests."""
+    from raft_sim_tpu.ops import tile
+
+    if widths is None:
+        widths = tile.pack_width_table(cfg)
+    if declared is None:
+        declared = policy.declared_ranges(cfg)
+    out: list[Finding] = []
+    path = f"range:{name}/pack"
+    for leg, (bits, bias, lo, hi) in sorted(widths.items()):
+        if lo + bias < 0 or hi + bias >= (1 << bits):
+            out.append(Finding(
+                rule="range-pack-width", path=path,
+                message=(
+                    f"compact plane `{leg}`: value range [{lo}, {hi}] with "
+                    f"bias {bias} does not fit {bits} bit(s) (biased range "
+                    f"must sit in [0, {(1 << bits) - 1}])"
+                ),
+            ))
+        d = declared.get(leg)
+        if d is not None and tuple(d) != (lo, hi):
+            out.append(Finding(
+                rule="range-pack-width", path=path,
+                message=(
+                    f"compact plane `{leg}`: pack-width table range "
+                    f"[{lo}, {hi}] disagrees with the types.py declared "
+                    f"range [{d[0]}, {d[1]}]"
+                ),
+            ))
+    return out
+
+
+def check_ceilings():
+    """Re-derive the types.py narrow-dtype ceilings from the config-module
+    formulas (satellite of the same PR that made them policy-sourced) and
+    compare. Returns (findings, ceilings-record)."""
+    from raft_sim_tpu import types as rst_types
+    from raft_sim_tpu.utils import config as cfg_mod
+
+    out: list[Finding] = []
+    path = "raft_sim_tpu/types.py"
+    derived = {
+        "MAX_INT8_LOG_CAPACITY": cfg_mod.max_log_capacity_for(127),
+        "MAX_INT8_NODES": cfg_mod.max_nodes_for(127),
+    }
+    for nm, want in derived.items():
+        have = getattr(rst_types, nm)
+        if have != want:
+            out.append(Finding(
+                rule="range-dtype-overflow", path=path,
+                message=(
+                    f"{nm} is {have} but the encoding-bound formula derives "
+                    f"{want}: the ceiling no longer matches the policy it "
+                    f"claims to encode"
+                ),
+            ))
+    enc = cfg_mod.window_min_encoding_max(cfg_mod.MAX_LOG_CAPACITY)
+    if enc > 32767:
+        out.append(Finding(
+            rule="range-dtype-overflow", path="raft_sim_tpu/utils/config.py",
+            message=(
+                f"MAX_LOG_CAPACITY={cfg_mod.MAX_LOG_CAPACITY} drives the "
+                f"window-min encoding to {enc}, beyond int16"
+            ),
+        ))
+    ceilings = dict(derived)
+    ceilings["MAX_LOG_CAPACITY"] = cfg_mod.MAX_LOG_CAPACITY
+    ceilings["window_min_encoding_max"] = enc
+    return out, ceilings
+
+
+# ------------------------------------------------------------- derive / pins
+
+
+def _range_label(program: str) -> str:
+    # Pass B labels programs "jaxpr:<tier>/<prog>"; Pass E findings live
+    # under "range:<tier>/<prog>" so waivers scope per pass.
+    return "range:" + program.split(":", 1)[1] if ":" in program else program
+
+
+@functools.lru_cache(maxsize=4)
+def _derive_all(config_names: tuple):
+    findings: list[tuple[str, str, str]] = []
+    tiers: dict[str, dict] = {}
+    for name in config_names:
+        cfg, _batch = PRESETS[name]
+        for program, closed, kind, rule_cfg in jaxpr_audit.programs(name, cfg):
+            label = _range_label(program)
+            try:
+                fs, record = audit_program(label, closed, kind, rule_cfg)
+            except Exception as ex:  # derivation failure must be VISIBLE
+                fs = [Finding(
+                    rule="range-golden", path=label,
+                    message=(
+                        f"range derivation failed ({type(ex).__name__}: {ex}): "
+                        f"the value-range gates for this program are NOT "
+                        f"being checked"
+                    ),
+                )]
+                record = None
+            findings.extend((f.rule, f.path, f.message) for f in fs)
+            if program.endswith("/simulate") and record is not None:
+                tiers[name] = {"legs": record}
+        tiers.setdefault(name, {"legs": {}})
+        from raft_sim_tpu.ops import tile
+
+        tiers[name]["pack_widths"] = {
+            leg: list(w) for leg, w in sorted(tile.pack_width_table(cfg).items())
+        }
+        findings.extend(
+            (f.rule, f.path, f.message) for f in check_pack_widths(cfg, name)
+        )
+    ceil_finds, ceilings = check_ceilings()
+    findings.extend((f.rule, f.path, f.message) for f in ceil_finds)
+    doc = {
+        "jax_version": jax.__version__,
+        "audit_horizon": H_AUDIT,
+        "soak_ticks": SOAK_TICKS,
+        "ceilings": ceilings,
+        "tiers": tiers,
+    }
+    return doc, tuple(findings)
+
+
+def derive_all(config_names=jaxpr_audit.AUDIT_CONFIGS):
+    """Derived ranges + the derivation-time findings. The cache stores
+    findings as plain tuples so waiver application never mutates cached
+    state across runs."""
+    doc, finds = _derive_all(tuple(config_names))
+    return doc, [Finding(rule=r, path=p, message=m) for r, p, m in finds]
+
+
+def _legs_equal(d: dict, g: dict, tol_rel: float) -> bool:
+    for k in ("lo", "hi", "dtype", "rate", "widened"):
+        if d.get(k) != g.get(k):
+            return False
+    # `escape` distinguishes absent (proven inductive) from null (image
+    # unknown) from a pinned [lo, hi] overshoot -- all three must match.
+    if ("escape" in d, d.get("escape")) != ("escape" in g, g.get("escape")):
+        return False
+    dh, gh = d.get("horizon"), g.get("horizon")
+    if dh is None or gh is None:
+        return dh == gh
+    return abs(dh - gh) <= tol_rel * abs(gh)
+
+
+def compare(derived: dict, golden: dict, *, full: bool = True) -> list[Finding]:
+    """All golden-pin findings: derived ranges vs tests/golden_ranges.json.
+    `full` = the derivation covered every audited tier, so golden tiers with
+    no derived counterpart are stale."""
+    out: list[Finding] = []
+    tol = (golden.get("tolerance") or {}).get(
+        "horizon_rel", DEFAULT_TOLERANCE["horizon_rel"])
+    g_tiers = golden.get("tiers") or {}
+    for name, d in derived["tiers"].items():
+        g = g_tiers.get(name)
+        if g is None:
+            out.append(Finding(
+                rule="range-golden", path=f"range:{name}/golden",
+                message=f"audited tier has no golden range pins -- {_REGEN}",
+            ))
+            continue
+        diffs = []
+        g_legs = g.get("legs") or {}
+        for leg, dl in d["legs"].items():
+            gl = g_legs.get(leg)
+            if gl is None:
+                diffs.append(f"`{leg}` has no pin")
+            elif not _legs_equal(dl, gl, tol):
+                diffs.append(
+                    f"`{leg}` pinned [{gl.get('lo')}, {gl.get('hi')}] "
+                    f"h={gl.get('horizon')} now [{dl.get('lo')}, "
+                    f"{dl.get('hi')}] h={dl.get('horizon')}"
+                )
+        for leg in g_legs:
+            if leg not in d["legs"]:
+                diffs.append(f"`{leg}` pinned but no longer derived")
+        if d.get("pack_widths") != g.get("pack_widths"):
+            diffs.append("pack-width table drifted from its pin")
+        if diffs:
+            shown = "; ".join(diffs[:4])
+            more = f" (+{len(diffs) - 4} more)" if len(diffs) > 4 else ""
+            out.append(Finding(
+                rule="range-golden", path=f"range:{name}/golden",
+                message=f"range pins drifted: {shown}{more} -- {_REGEN}",
+            ))
+    if full:
+        for name in g_tiers:
+            if name not in derived["tiers"]:
+                out.append(Finding(
+                    rule="range-golden", path=f"range:{name}/golden",
+                    message=(
+                        f"golden pins a tier the audit no longer derives "
+                        f"-- {_REGEN}"
+                    ),
+                ))
+    if derived.get("ceilings") != golden.get("ceilings"):
+        out.append(Finding(
+            rule="range-golden", path="range:ceilings/golden",
+            message=(
+                f"pinned dtype ceilings {golden.get('ceilings')} differ from "
+                f"derived {derived.get('ceilings')} -- {_REGEN}"
+            ),
+        ))
+    return out
+
+
+def run_pass(config_names=jaxpr_audit.AUDIT_CONFIGS,
+             golden_file: str | None = None) -> list[Finding]:
+    """The full value-range pass: derive, load pins, compare. A missing or
+    unreadable golden file is itself a finding -- the gate must force the
+    pins into existence, not silently pass without them."""
+    golden_file = golden_file or golden_path()
+    rel = os.path.relpath(golden_file, _REPO_ROOT)
+    derived, findings = derive_all(config_names)
+    try:
+        with open(golden_file) as f:
+            golden = json.load(f)
+    except FileNotFoundError:
+        return findings + [Finding(
+            rule="range-golden", path=rel,
+            message=(
+                "no golden range pins: generate them with "
+                "`python tools/check.py --update-goldens` and commit the file"
+            ),
+        )]
+    except (OSError, json.JSONDecodeError) as ex:
+        return findings + [Finding(
+            rule="range-golden", path=rel,
+            message=f"golden range file unreadable: {ex}",
+        )]
+    full = tuple(config_names) == tuple(jaxpr_audit.AUDIT_CONFIGS)
+    return findings + compare(derived, golden, full=full)
+
+
+def update_golden(path: str | None = None,
+                  config_names=jaxpr_audit.AUDIT_CONFIGS) -> str:
+    """Regenerate tests/golden_ranges.json from the current tree (the
+    `tools/check.py --update-goldens` path). A tuned tolerance in the
+    existing file survives regeneration (Pass C precedent)."""
+    path = path or golden_path()
+    derived, _findings = derive_all(config_names)
+    tolerance = dict(DEFAULT_TOLERANCE)
+    try:
+        with open(path) as f:
+            tolerance.update(json.load(f).get("tolerance") or {})
+    except (OSError, json.JSONDecodeError):
+        pass
+    doc = {
+        "jax_version": derived["jax_version"],
+        "audit_horizon": derived["audit_horizon"],
+        "soak_ticks": derived["soak_ticks"],
+        "tolerance": tolerance,
+        "ceilings": derived["ceilings"],
+        "tiers": derived["tiers"],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def diff_table(derived: dict, golden: dict, out=None) -> None:
+    """Pinned-vs-current interval table (the CI failure-triage rendering:
+    only legs that moved are printed, per tier)."""
+    import sys
+
+    out = out or sys.stdout
+    tol = (golden.get("tolerance") or {}).get(
+        "horizon_rel", DEFAULT_TOLERANCE["horizon_rel"])
+    g_tiers = golden.get("tiers") or {}
+    print(f"{'tier/leg':44} {'pinned':>24} {'current':>24}", file=out)
+    fmt = lambda e: (f"[{e.get('lo')}, {e.get('hi')}]"
+                     + (f" h={e.get('horizon')}" if e.get("horizon") is not None
+                        else "")) if e else "-"
+    for name in sorted(set(derived.get("tiers") or {}) | set(g_tiers)):
+        d_legs = (derived.get("tiers", {}).get(name) or {}).get("legs") or {}
+        g_legs = (g_tiers.get(name) or {}).get("legs") or {}
+        for leg in sorted(set(d_legs) | set(g_legs)):
+            dl, gl = d_legs.get(leg), g_legs.get(leg)
+            if dl and gl and _legs_equal(dl, gl, tol):
+                continue
+            print(f"{name + '/' + leg:44} {fmt(gl):>24} {fmt(dl):>24}",
+                  file=out)
+        dp = (derived.get("tiers", {}).get(name) or {}).get("pack_widths")
+        gp = (g_tiers.get(name) or {}).get("pack_widths")
+        if dp != gp:
+            print(f"{name + '/pack_widths':44} {str(gp):>24} {str(dp):>24}",
+                  file=out)

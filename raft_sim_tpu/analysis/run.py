@@ -27,6 +27,7 @@ def run_all(
     do_jaxpr: bool = True,
     do_cost: bool = True,
     do_race: bool = True,
+    do_range: bool = True,
     do_dynamic: bool = False,
     config_names=jaxpr_audit.AUDIT_CONFIGS,
     waivers_path: str | None = DEFAULT_WAIVERS,
@@ -39,14 +40,14 @@ def run_all(
     `do_dynamic` adds Pass D's runtime donation-poison leg (short
     sanitizer-armed standing-loop sessions -- the only part of the gate that
     executes device code beyond tiny donation probes)."""
-    from raft_sim_tpu.analysis import cost_model, race_audit
+    from raft_sim_tpu.analysis import cost_model, race_audit, range_audit
 
     found: list[F.Finding] = []
     active_rules: set[str] = set()
     timings: dict[str, float] = {}
     all_rules = (
         ast_lint.RULES | jaxpr_audit.RULES | cost_model.RULES
-        | race_audit.RULES
+        | race_audit.RULES | range_audit.RULES
     )
     if do_ast:
         t0 = time.monotonic()
@@ -73,6 +74,11 @@ def run_all(
             found.extend(dyn_findings)
         timings["race"] = round(time.monotonic() - t0, 2)
         active_rules |= race_audit.RULES
+    if do_range:
+        t0 = time.monotonic()
+        found.extend(range_audit.run_pass(config_names))
+        timings["range"] = round(time.monotonic() - t0, 2)
+        active_rules |= range_audit.RULES
     unused: list[dict] = []
     problems: list[str] = []
     if waivers_path:
@@ -81,7 +87,7 @@ def run_all(
         # A waiver is only STALE if the pass owning its rule actually ran (a
         # --jaxpr-only run must not condemn the AST pass's waivers). A rule
         # no pass knows -- a typo -- is stale whenever the full gate ran.
-        full = do_ast and do_jaxpr and do_cost and do_race
+        full = do_ast and do_jaxpr and do_cost and do_race and do_range
         unused = [
             w for w in unused
             if w.get("rule") in active_rules

@@ -502,7 +502,10 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
 
     # Follower commit: min(leaderCommit, index of last new entry), monotonic
     # (the reference's apply-entries! commits everything unconditionally, bug 2.3.6).
-    last_new = jnp.minimum(prev_i + n_acc, log_len)
+    # The floor at 0 is a no-op on the ae_ok path (prev_i/n_acc are
+    # non-negative for a real AE) but bounds the masked-garbage lane so the
+    # int8/int16 a_match narrowing below is provably in range (Pass E).
+    last_new = jnp.maximum(jnp.minimum(prev_i + n_acc, log_len), 0)
     commit = jnp.where(
         ae_ok,
         jnp.maximum(s.commit_index, jnp.minimum(lcommit, last_new)),
@@ -1353,10 +1356,13 @@ def _step(cfg: RaftConfig, s: ClusterState, inp: StepInputs) -> tuple[ClusterSta
     # next_index back down and re-admits it to the responsive set), and it bounds
     # prev - ws to E+1 values so the batch-minor kernel can read prev terms from
     # the shared window instead of a CAP-wide one-hot per edge.
-    prev_out = jnp.clip(prev_out, ws[:, None], (ws + e)[:, None])
+    # j = clip(prev, ws, ws+E) - ws == clip(prev - ws, 0, E); the difference
+    # form bounds the offset syntactically for the value-range audit.
+    off_j = jnp.clip(prev_out - ws[:, None], 0, e)
+    prev_out = ws[:, None] + off_j
     # Per-edge window offset j = prev - ws in 0..E; receivers reconstruct prev,
     # prev_term, and n_entries from (j, ent_start, ent_prev_term, ent_count).
-    out_req_off = jnp.where(ae_edge, prev_out - ws[:, None], 0).astype(jnp.int8)
+    out_req_off = jnp.where(ae_edge, off_j, 0).astype(jnp.int8)
     if comp:
         out_req_off = jnp.where(snap_edge, jnp.int8(-1), out_req_off)
     # Zero unused window slots so the mailbox is canonical (receivers mask with

@@ -436,11 +436,13 @@ def _step_b(
     if comp:
         snap = has_ae & (j_in < 0)
         ae_norm = has_ae & ~snap
-        j_nn = jnp.clip(j_in, 0, e)
     else:
         snap = jnp.zeros_like(has_ae)
         ae_norm = has_ae
-        j_nn = j_in
+    # Well-formed mailboxes keep the one-hot sum in [-1, E]; the clip bounds the
+    # fully-masked garbage lane (and routes snap's -1 to 0, gated by ae_norm), as
+    # in raft.py. Keeps prev_i provably within the idx dtype on wide-N tiers.
+    j_nn = jnp.clip(j_in, 0, e)
     ws_in = pick_h(mb.ent_start)
     lcommit = pick_h(mb.req_commit)
     prev_i = jnp.where(ae_norm, ws_in + j_nn, 0)
@@ -557,7 +559,10 @@ def _step_b(
     if not rcf:
         log_cfg_arr = s.log_cfg  # untouched: loop-invariant carry leg
 
-    last_new = jnp.minimum(prev_i + n_acc, log_len)
+    # The floor at 0 is a no-op on the ae_ok path (prev_i/n_acc are
+    # non-negative for a real AE) but bounds the masked-garbage lane so the
+    # int8/int16 a_match narrowing below is provably in range (Pass E).
+    last_new = jnp.maximum(jnp.minimum(prev_i + n_acc, log_len), 0)
     commit = jnp.where(
         ae_ok,
         jnp.maximum(s.commit_index, jnp.minimum(lcommit, last_new)),
@@ -1241,7 +1246,9 @@ def _step_b(
         # len-at-win) and drag the window start (pad_self == eye3 dense).
         off = prev_out + jnp.where(pad_self, K + K, jnp.where(responsive, z, K))
         m = jnp.min(off, axis=1)  # [N, B]
-        ws = jnp.where(m >= K, m - K, m)
+        # Both where-branches are non-negative under their conditions; the
+        # explicit floor makes that a local (range-provable) fact.
+        ws = jnp.maximum(jnp.where(m >= K, m - K, m), z)
     ws = jnp.minimum(ws, len_i)  # narrow dtype throughout; widened at header writes
     if comp:
         # The window cannot start below the compaction base; peers whose prev fell
@@ -1251,8 +1258,12 @@ def _step_b(
     # Clamp prev into [ws, ws+E] (see raft.py): the per-edge request payload then
     # reduces to the offset j = prev - ws in 0..E; receivers reconstruct prev,
     # prev_term, and n_entries from it and the per-sender header.
-    prev_out = jnp.clip(prev_out, ws[:, None, :], (ws + e)[:, None, :])
-    out_req_off = jnp.where(ae_edge, prev_out - ws[:, None, :], 0).astype(jnp.int8)
+    # j = clip(prev, ws, ws+E) - ws == clip(prev - ws, 0, E): the latter form
+    # bounds the offset *syntactically* (Pass E), where the subtract-after-clip
+    # form only bounds it relationally.
+    off_j = jnp.clip(prev_out - ws[:, None, :], 0, e)
+    prev_out = ws[:, None, :] + off_j
+    out_req_off = jnp.where(ae_edge, off_j, 0).astype(jnp.int8)
     if comp:
         out_req_off = jnp.where(snap_edge, jnp.int8(-1), out_req_off)
         wt = log_ops.window_rb(log_term_arr, ws, e)  # [N, E, B] shared window terms

@@ -181,7 +181,10 @@ def window_r(arr: jax.Array, start0: jax.Array, e: int) -> jax.Array:
     an explicit count (slots past the live range hold unrelated ring content)."""
     cap = arr.shape[-1]
     ks = jnp.arange(e, dtype=jnp.int32)
-    pos = (start0[..., None] + ks) % cap
+    # Unsigned modulo: start0 is an absolute (non-negative) ring anchor, so the
+    # uint view is value-identical -- and it skips the python-mod sign-fix
+    # select, leaving a provably in-[0, CAP) index (Pass E range-index-oob).
+    pos = ((start0[..., None] + ks).astype(jnp.uint32) % cap).astype(jnp.int32)
     n = arr.shape[0]
     rows = jnp.arange(n)[:, None] if start0.ndim == 1 else jnp.arange(n)[:, None, None]
     return arr[rows, pos]

@@ -26,8 +26,11 @@ legs:
     (votes at N=51: [51, 2] words pad to [51, 8] = 1632 B; flat [102] pads to
     [104] = 416 B).
 
-Value-range contract (the bit widths; restated independently by the oracle,
-pinned against this module in tests/test_constants.py):
+Value-range contract (the bit widths; canonical machine-readable form is
+`pack_width_table` below -- consumed by the plans here and by the value-range
+audit (analysis/range_audit.py) -- restated independently by the oracle
+(tests/oracle.py pack_widths) and pinned against this module in
+tests/test_constants.py):
 
   next_index   1 .. cap+1        -> bits_for(cap + 2)   (non-compaction only:
   match_index  0 .. cap             compaction carries absolute unbounded
@@ -85,6 +88,32 @@ def off_bits(cfg: RaftConfig) -> int:
 
 
 RESP_BITS = 2  # RESP_* is 0..3 (types.py)
+
+
+def pack_width_table(cfg: RaftConfig) -> dict[str, tuple[int, int, int, int]]:
+    """THE pack-width table: field -> (bits, bias, lo, hi) for every bit-packed
+    leg of the compacted layout, where lo..hi is the leg's dense value range
+    and bias shifts it non-negative before packing (stored = value + bias,
+    0 <= stored < 2**bits).
+
+    Single source of truth: `state_plan`/`mailbox_plan` size their pack legs
+    from it, the value-range audit (analysis/range_audit.py, rule
+    range-pack-width) proves declared ranges fit these widths, and the
+    oracle's independent restatement (tests/oracle.py `pack_widths` -- kept
+    import-free of this package so it stays a real second implementation) is
+    pinned against it in tests/test_constants.py. Index legs appear only for
+    non-compaction configs: compaction carries absolute unbounded indices as
+    dense int32, so no width exists for them.
+    """
+    cap, sat, e = cfg.log_capacity, cfg.ack_age_sat, cfg.max_entries_per_rpc
+    table = {}
+    if not cfg.compaction:
+        table["next_index"] = (index_bits(cfg), 0, 1, cap + 1)
+        table["match_index"] = (index_bits(cfg), 0, 0, cap)
+    table["ack_age"] = (age_bits(cfg), 0, 0, sat)
+    table["mb.req_off"] = (off_bits(cfg), 1, -1, e)
+    table["mb.resp_kind"] = (RESP_BITS, 0, 0, 3)
+    return table
 
 
 def words_for(m: int, bits: int) -> int:
@@ -148,18 +177,19 @@ def state_plan(cfg: RaftConfig):
 
     n = cfg.n_nodes
     w = bitplane.n_words(n)
+    widths = pack_width_table(cfg)
     plan = [("votes", "flat", (n, w), 0, 0, jnp.uint32)]
     if not cfg.compaction:
         # Compaction carries absolute (unbounded) int32 indices: no static
-        # bit bound exists, so next/match stay dense there (types.index_dtype).
+        # bit bound exists, so next/match stay dense there (types.index_dtype)
+        # and pack_width_table has no entry for them.
         idt = rst_types.index_dtype(cfg)
-        ib = index_bits(cfg)
         plan += [
-            ("next_index", "pack", (n, n), ib, 0, idt),
-            ("match_index", "pack", (n, n), ib, 0, idt),
+            ("next_index", "pack", (n, n), widths["next_index"][0], 0, idt),
+            ("match_index", "pack", (n, n), widths["match_index"][0], 0, idt),
         ]
     plan.append(
-        ("ack_age", "pack", (n, n), age_bits(cfg), 0, rst_types.ack_dtype(cfg))
+        ("ack_age", "pack", (n, n), widths["ack_age"][0], 0, rst_types.ack_dtype(cfg))
     )
     return plan
 
@@ -170,8 +200,9 @@ def mailbox_plan(cfg: RaftConfig):
     gates; gated-off legs are flat zeros passed through untouched
     (`pack_state` reuse)."""
     n, e = cfg.n_nodes, cfg.max_entries_per_rpc
+    widths = pack_width_table(cfg)
     return [
-        ("req_off", "pack", (n, n), off_bits(cfg), 1, jnp.int8),
+        ("req_off", "pack", (n, n), widths["mb.req_off"][0], widths["mb.req_off"][1], jnp.int8),
         ("resp_kind", "pack", (n, n), RESP_BITS, 0, jnp.int8),
         ("ent_term", "flat", (n, e), 0, 0, jnp.int32),
         ("ent_val", "flat", (n, e), 0, 0, jnp.int32),

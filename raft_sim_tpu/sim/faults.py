@@ -239,14 +239,17 @@ def _client_routing(cfg: RaftConfig, tkey: jax.Array):
 
 def genome_at(genome, now: jax.Array, seg_len: int):
     """Resolve a `[S]`-segment genome to the segment active at tick `now`:
-    dense-table read `leaves[min(now // seg_len, S - 1)]` on device (the
+    dense-table read `leaves[clip(now // seg_len, 0, S - 1)]` on device (the
     phased-nemesis timeline of scenario/program.py). The final segment holds
     past the program's end; S = 1 short-circuits to a static index so plain
     (unphased) genomes pay no gather."""
     s_count = genome.drop.shape[0]
     if s_count == 1:
         return jax.tree.map(lambda t: t[0], genome)
-    seg = jnp.minimum(now // seg_len, s_count - 1)
+    # clip, not minimum: `now` is -1 during the phantom pre-window (see
+    # _cut_count), and a negative index would silently read the FINAL
+    # segment instead of the first one (Pass E range-index-oob).
+    seg = jnp.clip(now // seg_len, 0, s_count - 1)
     return jax.tree.map(lambda t: t[seg], genome)
 
 

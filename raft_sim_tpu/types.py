@@ -42,6 +42,7 @@ import jax.numpy as jnp
 # ACK_AGE_SAT* are re-exported here because state builders read them alongside
 # ClusterState; they live in config (the leaf module) for the validator.
 from raft_sim_tpu.ops import bitplane
+from raft_sim_tpu.utils import config as config_mod
 from raft_sim_tpu.utils.config import (
     ACK_AGE_SAT,
     ACK_AGE_SAT_NARROW,
@@ -97,12 +98,13 @@ NOOP = -2
 # log_capacity ceiling for int8 index planes: the single-pass window-start min
 # (models/raft_batched.py phase 8) encodes self as +2K and unresponsive peers as
 # +K with K = cap + 1, so the largest encoded value is 2K + cap = 3*cap + 2,
-# which must fit the plane dtype. Asserted at import so widening a ceiling
-# without widening the dtype (or the encoding) is an immediate error, not a
-# silent negative-wrap in the window min.
-MAX_INT8_LOG_CAPACITY = 41
-assert 3 * MAX_INT8_LOG_CAPACITY + 2 <= 127  # int8 tier
-assert 3 * MAX_LOG_CAPACITY + 2 <= 32767  # int16 tier (utils/config.py ceiling)
+# which must fit the plane dtype. The ceiling is DERIVED from that encoding
+# bound (utils/config.max_log_capacity_for, shared with analysis Pass E) so
+# widening it without widening the dtype (or the encoding) is impossible by
+# construction, not just caught by an assert: (127 - 2) // 3 = 41.
+MAX_INT8_LOG_CAPACITY = config_mod.max_log_capacity_for(127)
+assert config_mod.window_min_encoding_max(MAX_INT8_LOG_CAPACITY) <= 127  # int8 tier
+assert config_mod.window_min_encoding_max(MAX_LOG_CAPACITY) <= 32767  # int16 tier
 
 
 def ack_dtype(cfg: RaftConfig):
@@ -123,9 +125,10 @@ def index_dtype(cfg: RaftConfig):
 
 # n_nodes ceiling for int8 node-id wire fields (Mailbox xfer_tgt/v_to/a_ok_to and
 # the kernels' grant_to/a_ok_to casts): ids 0..n-1 plus the NIL = -1 sentinel and
-# the `n` sentinel the min-select patterns use must all fit the dtype. 126 keeps
-# n itself (the sentinel) a valid int8 value with a slot to spare.
-MAX_INT8_NODES = 126
+# the `n` sentinel the min-select patterns use must all fit the dtype. Derived
+# (utils/config.max_nodes_for, shared with analysis Pass E): 127 - 1 = 126
+# keeps n itself (the sentinel) a valid int8 value with a slot to spare.
+MAX_INT8_NODES = config_mod.max_nodes_for(127)
 
 
 def node_dtype(cfg: RaftConfig):
@@ -195,14 +198,14 @@ class Mailbox(NamedTuple):
     committed entries (v8's packed word capped compaction runs at 2^28).
     """
 
-    req_type: jax.Array  # [N(sender)] int32 (REQ_*): this tick's broadcast, if any
+    req_type: jax.Array  # [N(sender)] int32 in [0, 4] (REQ_*): this tick's broadcast, if any
     req_term: jax.Array  # [N] int32: sender's term at send time
     req_commit: jax.Array  # [N] int32: AE leaderCommit
     req_last_index: jax.Array  # [N] int32: RV lastLogIndex
     req_last_term: jax.Array  # [N] int32: RV lastLogTerm
-    ent_start: jax.Array  # [N] int32: 1-based index before src's shared window (= prev at j=0)
+    ent_start: jax.Array  # [N] int32 in [0, cap]: 1-based index before src's shared window (= prev at j=0)
     ent_prev_term: jax.Array  # [N] int32: term of the 1-based entry ent_start (j=0 prev)
-    ent_count: jax.Array  # [N] int32: entries shipped = min(log_len - ent_start, E)
+    ent_count: jax.Array  # [N] int32 in [0, E]: entries shipped = min(log_len - ent_start, E)
     ent_term: jax.Array  # [N, E] int32: src's shared entry window (terms)
     ent_val: jax.Array  # [N, E] int32: src's shared entry window (values)
     # Offer-tick plane of the shared window (cfg.track_offer_ticks only; zeros
@@ -223,7 +226,7 @@ class Mailbox(NamedTuple):
     # untouched otherwise): the target of the sender's TimeoutNow broadcast
     # (REQ_TIMEOUT_NOW). Per sender like every request header -- a leader
     # fires at most one transfer per tick.
-    xfer_tgt: jax.Array  # [N(sender)] int8/int16 (node_dtype): TimeoutNow target node (NIL = none)
+    xfer_tgt: jax.Array  # [N(sender)] int8/int16 (node_dtype) in [NIL, N-1]: TimeoutNow target node (NIL = none)
     # Disruptive-RequestVote flag (thesis 4.2.3's override, paired with
     # TimeoutNow in 3.10): set on the RequestVote broadcast of a transfer-
     # triggered election, so voters holding the heard-a-leader denial (live
@@ -232,7 +235,7 @@ class Mailbox(NamedTuple):
     # leader being replaced, so denying it would deadlock every transfer.
     # Written only when the flag has a reader (cfg.leader_transfer AND a
     # denial gate); zeros and carried untouched otherwise.
-    req_disrupt: jax.Array  # [N(sender)] int8: 1 = transfer-sanctioned RequestVote
+    req_disrupt: jax.Array  # [N(sender)] int8 in [0, 1]: 1 = transfer-sanctioned RequestVote
     # Config-entry plane of the shared window (cfg.reconfig only; zeros and
     # carried untouched otherwise): entry k's config command replicates NEXT
     # TO its value, exactly like the offer-stamp plane -- so a follower's
@@ -247,13 +250,13 @@ class Mailbox(NamedTuple):
     req_base_mold: jax.Array  # [N, W] uint32: sender's C_old at its base
     req_base_pend: jax.Array  # [N] int32: sender's pending toggle code at base
     req_base_epoch: jax.Array  # [N] int32: sender's config-entry count at base
-    req_off: jax.Array  # [N(sender), N(receiver)] int8: AE window offset j in 0..E; -1 = snapshot
-    resp_kind: jax.Array  # [N(receiver), N(responder)] int8 (RESP_*): response type per edge
+    req_off: jax.Array  # [N(sender), N(receiver)] int8 in [-1, E]: AE window offset j; -1 = snapshot
+    resp_kind: jax.Array  # [N(receiver), N(responder)] int8 in [0, 3] (RESP_*): response type per edge
     pv_grant: jax.Array  # [N(receiver), W] uint32: packed pre-vote grant bits (bit = responder)
-    v_to: jax.Array  # [N(responder)] int8/int16 (node_dtype): candidate granted this tick (NIL = none)
-    a_ok_to: jax.Array  # [N(responder)] int8/int16 (node_dtype): AE sender acked OK this tick (NIL = none)
-    a_match: jax.Array  # [N(responder)] int16/int32 (index_dtype): acked index of the successful append
-    a_hint: jax.Array  # [N(responder)] int16/int32 (index_dtype): nack hint (responder's log length)
+    v_to: jax.Array  # [N(responder)] int8/int16 (node_dtype) in [NIL, N]: candidate granted this tick (NIL = none; N = masked no-sender sentinel)
+    a_ok_to: jax.Array  # [N(responder)] int8/int16 (node_dtype) in [NIL, N]: AE sender acked OK this tick (NIL = none; N = masked no-sender sentinel)
+    a_match: jax.Array  # [N(responder)] int16/int32 (index_dtype) in [0, cap]: acked index of the successful append
+    a_hint: jax.Array  # [N(responder)] int16/int32 (index_dtype) in [0, cap]: nack hint (responder's log length)
     resp_term: jax.Array  # [N(responder)] int32: responder's term at send time
 
 
@@ -269,10 +272,10 @@ class ClusterState(NamedTuple):
       clock/deadline                 <- async/timeout channels (core.clj:171-174)
     """
 
-    role: jax.Array  # [N] int32
+    role: jax.Array  # [N] int32 in [0, 3] (FOLLOWER..PRECANDIDATE)
     term: jax.Array  # [N] int32 (starts at 1, core.clj:34)
-    voted_for: jax.Array  # [N] int32 (NIL = none)
-    leader_id: jax.Array  # [N] int32 (NIL = unknown)
+    voted_for: jax.Array  # [N] int32 in [NIL, N] (NIL = none; N = masked no-candidate sentinel)
+    leader_id: jax.Array  # [N] int32 in [NIL, N] (NIL = unknown; N = masked no-sender sentinel)
     # Bit-packed votes bitmap (ops/bitplane.py): bit j of votes[i] set = node i
     # holds a granted vote (or pre-vote grant, while PRECANDIDATE) from node j.
     # The quorum test is a word popcount (bitplane.count >= cfg.quorum), and the
@@ -288,16 +291,16 @@ class ClusterState(NamedTuple):
     # bit-packed flat uint32 layout of ops/tile.py; the comments below state
     # the dense contract the kernels compute on (tile.unpack_state at tick
     # entry, pack_state at exit -- bit-identical trajectories either way).
-    next_index: jax.Array  # [N, N] index_dtype; leader i's next index for peer j
-    match_index: jax.Array  # [N, N] index_dtype
+    next_index: jax.Array  # [N, N] index_dtype in [1, cap+1]; leader i's next index for peer j
+    match_index: jax.Array  # [N, N] index_dtype in [0, cap]
     # Ticks since leader i last received an AppendEntries response (success OR
     # failure -- both prove the peer is up) from peer j, saturating at
     # cfg.ack_age_sat (int8 plane whenever that ceiling fits -- ack_dtype);
     # zeroed for the whole row when i wins an election (grace period). Volatile
     # leader bookkeeping like next/match; drives the shared-entry-window
     # responsiveness filter (config.ack_timeout_ticks).
-    ack_age: jax.Array  # [N, N] ack_dtype (int8/int16)
-    commit_index: jax.Array  # [N] int32
+    ack_age: jax.Array  # [N, N] ack_dtype in [0, sat] (int8/int16)
+    commit_index: jax.Array  # [N] int32 in [0, cap]
     # Weighted checksum of the committed prefix (log_ops.chk_weights), maintained
     # when config.check_invariants: the "committed entries are immutable" invariant
     # checks one pass over the new log arrays against this instead of re-reading the
@@ -327,7 +330,7 @@ class ClusterState(NamedTuple):
     # metadata, not protocol state: excluded from the commit checksum and the
     # log-matching compare, and restart-persistent alongside the log it tags.
     log_tick: jax.Array  # [N, CAP] int32
-    log_len: jax.Array  # [N] int32
+    log_len: jax.Array  # [N] int32 in [0, cap]
     # Durable storage plane (raft_sim_tpu/storage; all legs zeros/boot values
     # and carried untouched unless cfg.durable_storage). The dissertation's
     # section 3.8 persistent triple -- currentTerm, votedFor, the log -- is
@@ -340,7 +343,7 @@ class ClusterState(NamedTuple):
     # Truncation clamps dur_len down with log_len (removed entries are no
     # longer durable as log content). v1 excludes compaction (dur_len would
     # have to fold across snapshot installs) -- asserted by RaftConfig.
-    dur_len: jax.Array  # [N] int32: fsynced log prefix length (<= log_len)
+    dur_len: jax.Array  # [N] int32 in [0, cap]: fsynced log prefix length (<= log_len)
     dur_term: jax.Array  # [N] int32: term at the last flush (boot: 1)
     dur_vote: jax.Array  # [N] int32: votedFor at the last flush (NIL = none)
     clock: jax.Array  # [N] int32 local (skewable) clock
@@ -395,7 +398,7 @@ class ClusterState(NamedTuple):
     # (thesis 3.10). Volatile leader state: cleared on role loss, term
     # adoption, restart, or target unresponsiveness; re-fired each heartbeat
     # while pending and caught up (a dropped TimeoutNow retries).
-    xfer_to: jax.Array  # [N] int32: pending transfer target (NIL = idle)
+    xfer_to: jax.Array  # [N] int32 in [NIL, N-1]: pending transfer target (NIL = idle)
     # ReadIndex plane (cfg.read_index; zeros and carried untouched otherwise
     # -- thesis 6.4): one pending read slot per node. read_idx holds the
     # captured commit index + 1 (0 = no pending read) -- capture is gated on
@@ -461,14 +464,14 @@ class StepInputs(NamedTuple):
     # reduction and unpack once for the transposed request orientation; the
     # oracle unpacks (tests/oracle.py). W = ceil(N/32).
     deliver_mask: jax.Array  # [N, W] uint32; bit src of row dst
-    skew: jax.Array  # [N] int32 local-clock increment this tick (normally 1)
+    skew: jax.Array  # [N] int32 in [0, 2] local-clock increment this tick (normally 1)
     timeout_draw: jax.Array  # [N] int32 election timeout to use on any timer reset
     client_cmd: jax.Array  # scalar int32 command value offered this tick; NIL = none
     # Client routing draws (cfg.client_redirect; zeros otherwise): the node a
     # fresh offer targets, and the random peer each pipeline slot's leaderless
     # redirect bounces to (core.clj:154).
-    client_target: jax.Array  # scalar int32 in [0, N)
-    client_bounce: jax.Array  # [K] int32 in [0, N)
+    client_target: jax.Array  # scalar int32 in [0, N-1]
+    client_bounce: jax.Array  # [K] int32 in [0, N-1]
     alive: jax.Array  # [N] bool; False = node crashed this tick (silent, frozen)
     restarted: jax.Array  # [N] bool; True = node came back up this tick (volatile wipe)
     # Reconfiguration-plane admin commands (all NIL unless their gate is on;
@@ -483,9 +486,9 @@ class StepInputs(NamedTuple):
     # would initialize the backend at import, before driver.select_backend)
     # so hand-built test inputs predating the plane stay valid; make_inputs
     # always materializes real arrays.
-    reconfig_cmd: jax.Array = NIL  # scalar int32 in [0, N); NIL = none
-    transfer_cmd: jax.Array = NIL  # scalar int32 in [0, N); NIL = none
-    read_cmd: jax.Array = NIL  # scalar int32 0/1 flag encoded as value; NIL = none
+    reconfig_cmd: jax.Array = NIL  # scalar int32 in [NIL, N-1]; NIL = none
+    transfer_cmd: jax.Array = NIL  # scalar int32 in [NIL, N-1]; NIL = none
+    read_cmd: jax.Array = NIL  # scalar int32 in [NIL, 1]: 0/1 flag encoded as value; NIL = none
     # Durable storage plane draws (cfg.durable_storage; all-zero arrays
     # otherwise -- sim/faults._storage_draws). fsync_fire marks the nodes
     # whose disk completes a flush THIS tick (the cadence tick, minus the

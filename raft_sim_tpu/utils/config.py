@@ -28,13 +28,48 @@ import dataclasses
 ACK_AGE_SAT_NARROW = 120
 ACK_AGE_SAT = 30000
 
+# --- Ceiling derivations (single source for types.py and analysis Pass E) ---
+#
+# The narrow-dtype ceilings used to live as hand-computed literals with ad-hoc
+# module-level asserts in types.py. They are now DERIVED here from the two
+# encoding bounds that motivate them, so the constants, the dtype-policy
+# functions in types.py, and the value-range audit (analysis/range_audit.py)
+# all read one formula and cannot drift apart.
+
+
+def window_min_encoding_max(log_capacity: int) -> int:
+    """Largest value the single-pass window-start min ever encodes.
+
+    models/raft_batched.py phase 8 folds responsiveness into one min by
+    biasing prev-index (0..cap) with K = cap + 1: self contributes +2K,
+    unresponsive peers +K, so the ceiling is 2K + cap = 3*cap + 2.
+    """
+    return 3 * log_capacity + 2
+
+
+def max_log_capacity_for(dtype_max: int) -> int:
+    """Largest log_capacity whose window-min encoding fits a dtype ceiling."""
+    return (dtype_max - 2) // 3
+
+
+def max_nodes_for(dtype_max: int) -> int:
+    """Largest n_nodes whose node-id vocabulary fits a dtype ceiling.
+
+    Node planes carry ids 0..n-1, NIL = -1, and the out-of-range sentinel n
+    (reconfig swaps use it as "no node"), so n itself must fit: n <= dtype_max
+    with one slot to spare for the sentinel -> ceiling dtype_max - 1.
+    """
+    return dtype_max - 1
+
+
 # Upper bound on RaftConfig.log_capacity. Log indices ride int16 state planes
 # at most (ClusterState.next_index/match_index; int8 below
-# types.MAX_INT8_LOG_CAPACITY), and the single-pass window-start min
-# (models/raft_batched.py phase 8) encodes its responsiveness fallback with
-# K = cap + 1 offsets, so its largest encoded value 3 * cap + 2 must fit the
-# plane dtype -- asserted at import in types.py next to the int8 ceiling.
+# types.MAX_INT8_LOG_CAPACITY = max_log_capacity_for(127)), and the
+# single-pass window-start min (models/raft_batched.py phase 8) encodes its
+# responsiveness fallback with K = cap + 1 offsets, so its largest encoded
+# value window_min_encoding_max(cap) = 3 * cap + 2 must fit the plane dtype.
 MAX_LOG_CAPACITY = 4095
+assert window_min_encoding_max(MAX_LOG_CAPACITY) <= 32767  # int16 tier
 
 
 @dataclasses.dataclass(frozen=True)

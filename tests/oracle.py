@@ -78,6 +78,26 @@ def _bits_for(n_values: int) -> int:
     return max(1, (n_values - 1).bit_length())
 
 
+def pack_widths(cfg) -> dict:
+    """Independent restatement of the compacted layout's pack-width table
+    (ops/tile.pack_width_table): field -> (bits, bias, lo, hi), where lo..hi
+    is the dense value range and bias shifts it non-negative before packing.
+    Deliberately import-free of raft_sim_tpu -- this is the oracle's own
+    derivation from the protocol bounds (next_index 1..cap+1 and match_index
+    0..cap, non-compaction only; ack_age saturating at the restated ceiling;
+    req_off -1..E with a +1 bias; resp_kind RESP_* 0..3) -- and pinned
+    against the tile.py table in tests/test_constants.py."""
+    cap, e, sat = cfg.log_capacity, cfg.max_entries_per_rpc, ack_age_sat(cfg)
+    table = {}
+    if cfg.compact_margin == 0:  # compaction carries dense absolute indices
+        table["next_index"] = (_bits_for(cap + 2), 0, 1, cap + 1)
+        table["match_index"] = (_bits_for(cap + 2), 0, 0, cap)
+    table["ack_age"] = (_bits_for(sat + 1), 0, 0, sat)
+    table["mb.req_off"] = (_bits_for(e + 2), 1, -1, e)
+    table["mb.resp_kind"] = (2, 0, 0, 3)
+    return table
+
+
 def unpack_values(words: np.ndarray, bits: int, count: int) -> np.ndarray:
     """Independent numpy restatement of the compacted sub-byte layout
     (ops/tile.py pack_words): k = 32 // bits values per uint32 word, value i
@@ -103,19 +123,21 @@ def _uncompact(cfg, d: dict) -> None:
     mb = d["mailbox"]
     idt = np.int8 if cap <= 41 else np.int16  # types.index_dtype, restated
     adt = np.int8 if ack_age_sat(cfg) < 127 else np.int16  # types.ack_dtype
+    widths = pack_widths(cfg)
+
+    def _un(leg, field, dtype):
+        bits, bias, _lo, _hi = widths[field]
+        vals = unpack_values(leg, bits, n * n)
+        if bias:
+            vals = vals - bias
+        return vals.astype(dtype).reshape(n, n)
+
     if cfg.compact_margin == 0:  # compaction carries dense absolute indices
-        ib = _bits_for(cap + 2)
-        d["next_index"] = unpack_values(d["next_index"], ib, n * n).astype(idt).reshape(n, n)
-        d["match_index"] = unpack_values(d["match_index"], ib, n * n).astype(idt).reshape(n, n)
-    d["ack_age"] = (
-        unpack_values(d["ack_age"], _bits_for(ack_age_sat(cfg) + 1), n * n)
-        .astype(adt).reshape(n, n)
-    )
-    mb["req_off"] = (
-        (unpack_values(mb["req_off"], _bits_for(e + 2), n * n) - 1)
-        .astype(np.int8).reshape(n, n)
-    )
-    mb["resp_kind"] = unpack_values(mb["resp_kind"], 2, n * n).astype(np.int8).reshape(n, n)
+        d["next_index"] = _un(d["next_index"], "next_index", idt)
+        d["match_index"] = _un(d["match_index"], "match_index", idt)
+    d["ack_age"] = _un(d["ack_age"], "ack_age", adt)
+    mb["req_off"] = _un(mb["req_off"], "mb.req_off", np.int8)
+    mb["resp_kind"] = _un(mb["resp_kind"], "mb.resp_kind", np.int8)
     d["votes"] = d["votes"].reshape(n, w)
     for f in ("ent_term", "ent_val", "ent_tick", "ent_cfg"):
         mb[f] = mb[f].reshape(n, e)

@@ -304,7 +304,8 @@ def test_partial_run_does_not_report_other_passes_waivers_stale():
     # The standing waivers belong to the AST pass; a jaxpr-only run must not
     # condemn them as stale (they were never given a chance to match).
     found, unused, problems, timings = run.run_all(
-        do_ast=False, do_cost=False, config_names=("config3",)
+        do_ast=False, do_cost=False, do_race=False, do_range=False,
+        config_names=("config3",)
     )
     assert set(timings) == {"jaxpr"}
     assert problems == []
@@ -333,7 +334,8 @@ def test_tree_gates_clean_ast_pass():
     cost passes run as the tools/check.py CI gate; their per-rule coverage on
     the real kernels is pinned by the tests above and by
     tests/test_cost_model.py)."""
-    found, unused, problems, _ = run.run_all(do_jaxpr=False, do_cost=False)
+    found, unused, problems, _ = run.run_all(
+        do_jaxpr=False, do_cost=False, do_range=False)
     assert problems == []
     assert unused == [], f"stale waivers: {unused}"
     unwaived = [f for f in found if not f.waived]

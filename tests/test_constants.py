@@ -24,6 +24,48 @@ def test_ack_age_sat_matches():
         assert oracle.ack_age_sat(cfg) == cfg.ack_age_sat
 
 
+def test_pack_width_table_matches():
+    """The compacted layout's pack-width table -- bits, bias, AND value range
+    per leg -- single-sourced in ops/tile.pack_width_table (the plans and the
+    value-range audit read it) and restated independently by the oracle
+    (oracle.pack_widths). Pinned across every audited tier, including the
+    compacted ones (config5c/config7x) and a compaction tier (no index legs)."""
+    from raft_sim_tpu.analysis.jaxpr_audit import AUDIT_CONFIGS
+    from raft_sim_tpu.ops import tile
+
+    for name in AUDIT_CONFIGS:
+        cfg, _batch = config.PRESETS[name]
+        assert oracle.pack_widths(cfg) == tile.pack_width_table(cfg), name
+    # The plans must size their pack legs from the same table.
+    for name in ("config5c", "config7x", "config6"):
+        cfg, _batch = config.PRESETS[name]
+        widths = tile.pack_width_table(cfg)
+        plans = list(tile.state_plan(cfg)) + [
+            (f"mb.{f}", mode, shape, bits, bias, dt)
+            for f, mode, shape, bits, bias, dt in tile.mailbox_plan(cfg)
+        ]
+        for f, mode, _shape, bits, bias, _dt in plans:
+            if mode != "pack":
+                continue
+            wbits, wbias, lo, hi = widths[f]
+            assert (bits, bias) == (wbits, wbias), (name, f)
+            # The declared range, biased, must exactly need the allotted bits.
+            assert lo + wbias == 0 or f == "next_index", (name, f)
+            assert hi + wbias < (1 << wbits), (name, f)
+            assert hi + wbias >= (1 << (wbits - 1)) or wbits == 1, (name, f)
+
+
+def test_int8_ceilings_derive_from_encoding_bounds():
+    """types.py's int8 ceilings are policy-sourced, not hand literals: they
+    derive from the window-min encoding bound (3*cap + 2 fits the dtype) and
+    the node-id sentinel bound (n fits with a slot to spare)."""
+    assert types.MAX_INT8_LOG_CAPACITY == config.max_log_capacity_for(127) == 41
+    assert types.MAX_INT8_NODES == config.max_nodes_for(127) == 126
+    assert config.window_min_encoding_max(types.MAX_INT8_LOG_CAPACITY) <= 127
+    assert config.window_min_encoding_max(types.MAX_INT8_LOG_CAPACITY + 1) > 127
+    assert config.window_min_encoding_max(config.MAX_LOG_CAPACITY) <= 32767
+
+
 def test_noop_sentinel_matches():
     assert oracle.NOOP == types.NOOP
     assert types.NOOP != types.NIL  # distinct sentinels

@@ -1,0 +1,212 @@
+"""Pass E (value-range abstract interpretation) coverage.
+
+One seeded negative per rule -- each must be caught NAMING the rule and the
+leg/site, so a regression in the interpreter cannot silently stop a gate
+from firing -- plus the waiver round trip, the derivation-failure
+visibility contract (a pass that cannot derive must say so, never pass
+silently), and the gate-status + runtime-budget pin on HEAD.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from raft_sim_tpu.analysis import jaxpr_audit, policy, range_audit, run
+from raft_sim_tpu.analysis import findings as F
+from raft_sim_tpu.ops import tile
+from raft_sim_tpu.utils.config import PRESETS
+
+CFG3 = PRESETS["config3"][0]
+
+
+def _program(name: str, prog: str = "simulate"):
+    cfg, _batch = PRESETS[name]
+    for program, closed, kind, rule_cfg in jaxpr_audit.programs(name, cfg):
+        if program.endswith("/" + prog):
+            return closed, kind, rule_cfg
+    raise AssertionError(f"{name} has no {prog} program")
+
+
+def _hits(findings, rule: str, needle: str):
+    return [f for f in findings if f.rule == rule and needle in f.message]
+
+
+# ---------------------------------------------------- seeded negatives (one
+# per rule: the gate must name the rule AND the offending leg/site)
+
+
+def test_seeded_widened_index_leg_fires_dtype_overflow():
+    # Widen an index plane's declared range past its int8 plane: the scan
+    # seeding must refuse the axiom and name the leg.
+    closed, kind, cfg = _program("config3")
+    declared = dict(policy.declared_ranges(cfg))
+    assert "next_index" in declared
+    declared["next_index"] = (1, 200)  # int8 plane tops out at 127
+    finds, _rec = range_audit.audit_program(
+        "range:seeded/simulate", closed, kind, cfg, declared=declared)
+    hits = _hits(finds, "range-dtype-overflow", "`next_index`")
+    assert hits, [f"{f.rule}: {f.message}" for f in finds]
+    assert "does not fit" in hits[0].message
+
+
+def test_seeded_pack_width_shrunk_one_bit_fires():
+    cfg, _batch = PRESETS["config5c"]
+    widths = dict(tile.pack_width_table(cfg))
+    assert range_audit.check_pack_widths(cfg, "config5c") == []
+    bits, bias, lo, hi = widths["ack_age"]
+    widths["ack_age"] = (bits - 1, bias, lo, hi)  # 120 no longer fits 6 bits
+    finds = range_audit.check_pack_widths(cfg, "config5c", widths=widths)
+    hits = _hits(finds, "range-pack-width", "`ack_age`")
+    assert hits and "does not fit" in hits[0].message
+
+
+def test_seeded_unclipped_take_along_axis_fires_index_oob():
+    def f(x):
+        i = jnp.full((3,), 9, jnp.int32)  # provably outside operand extent 8
+        return jnp.take_along_axis(x, i, axis=0, mode="promise_in_bounds")
+
+    closed = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((8,), jnp.int32))
+    finds: list = []
+    interp = range_audit._Interp(
+        "range:seeded/oob", CFG3, declared={}, leg_names=None,
+        target_nk=None, invariant=frozenset(), findings=finds)
+    interp.eval_closed(
+        closed, [range_audit._top(v.aval) for v in closed.jaxpr.invars])
+    hits = _hits(finds, "range-index-oob", "promise")
+    assert hits, [f"{f.rule}: {f.message}" for f in finds]
+
+
+def test_seeded_stale_declared_range_fires_annotation_stale():
+    # A declared range the initial state provably contradicts (the "comment
+    # went stale" failure: code moved, annotation did not).
+    closed, kind, cfg = _program("config3")
+    declared = dict(policy.declared_ranges(cfg))
+    assert "commit_index" in declared
+    declared["commit_index"] = (5, 9)  # initial commit index is 0
+    finds, _rec = range_audit.audit_program(
+        "range:seeded/simulate", closed, kind, cfg, declared=declared)
+    hits = _hits(finds, "range-annotation-stale", "`commit_index`")
+    assert hits, [f"{f.rule}: {f.message}" for f in finds]
+    assert "[5, 9]" in hits[0].message
+
+
+def test_seeded_int16_term_leg_fires_horizon_below_soak():
+    # A monotone protocol leg forced onto int16 wraps at 32767 -- far below
+    # the 10M-tick soak budget; the horizon rule must fire naming the leg.
+    def body(c, x):
+        return c + jnp.int16(1), x
+
+    def prog(xs):
+        return lax.scan(body, jnp.int16(0), xs)
+
+    closed = jax.make_jaxpr(prog)(jax.ShapeDtypeStruct((4,), jnp.int16))
+    finds, rec = range_audit.audit_program(
+        "range:seeded/horizon", closed, "scan", CFG3,
+        declared={}, leg_names=["term"])
+    hits = _hits(finds, "range-horizon", "`term`")
+    assert hits, [f"{f.rule}: {f.message}" for f in finds]
+    assert rec["term"]["rate"] == 1
+    assert rec["term"]["horizon"] == 32767 < range_audit.SOAK_TICKS
+
+
+# --------------------------------------------------- failure visibility
+
+
+def test_missing_target_scan_is_visible_not_silent():
+    def body(c, x):
+        return c + jnp.int32(1), x
+
+    def prog(xs):
+        return lax.scan(body, jnp.int32(0), xs)
+
+    closed = jax.make_jaxpr(prog)(jax.ShapeDtypeStruct((4,), jnp.int32))
+    finds, rec = range_audit.audit_program(
+        "range:seeded/miss", closed, "scan", CFG3,
+        declared={}, leg_names=["a", "b"])  # no 2-leg carry exists
+    assert rec is None
+    assert _hits(finds, "range-golden", "NOT being checked")
+
+
+def test_derivation_exception_is_visible_not_silent(monkeypatch):
+    def boom(*a, **k):
+        raise RuntimeError("seeded derivation failure")
+
+    range_audit._derive_all.cache_clear()
+    monkeypatch.setattr(range_audit, "audit_program", boom)
+    try:
+        _doc, finds = range_audit.derive_all(("config3",))
+    finally:
+        # Never leave the seeded-failure derivation in the shared cache.
+        range_audit._derive_all.cache_clear()
+    hits = _hits(finds, "range-golden", "NOT being checked")
+    assert hits and "seeded derivation failure" in hits[0].message
+
+
+# ------------------------------------------------------- waiver round trip
+
+
+def test_range_waiver_round_trip():
+    f = F.Finding(rule="range-dtype-overflow", path="range:config3/simulate",
+                  message="carry leg `x`: proven interval exceeds int8")
+    waivers = [{"rule": "range-dtype-overflow",
+                "path": "range:config3/simulate",
+                "contains": "`x`", "reason": "seeded"}]
+    assert F.apply_waivers([f], waivers) == []
+    assert f.waived and f.waiver_reason == "seeded"
+    # Same waiver against a different leg: no match, reported stale.
+    g = F.Finding(rule="range-dtype-overflow", path="range:config3/simulate",
+                  message="carry leg `y`: proven interval exceeds int8")
+    assert F.apply_waivers([g], waivers) == waivers
+    assert not g.waived
+
+
+def test_range_waivers_not_condemned_by_other_pass_runs(tmp_path):
+    # Stale-waiver scoping: an AST-only run must not mark a range-rule
+    # waiver stale (the range pass never got a chance to match it).
+    p = tmp_path / "w.json"
+    p.write_text(json.dumps({"schema_version": 1, "waivers": [{
+        "rule": "range-dtype-overflow", "path": "range:config3/simulate",
+        "reason": "scoping probe"}]}))
+    found, unused, problems, timings = run.run_all(
+        do_jaxpr=False, do_cost=False, do_race=False, do_range=False,
+        waivers_path=str(p))
+    assert problems == []
+    assert set(timings) == {"ast"}
+    assert unused == []
+
+
+# ------------------------------------------------- gate status + budget
+
+
+def test_range_pass_clean_on_head_within_budget():
+    """HEAD derives, matches tests/golden_ranges.json, and stays inside the
+    analyzer budget (lowerings are lru-shared with the jaxpr/cost passes, so
+    this prices the interpreter + golden compare)."""
+    t0 = time.monotonic()
+    finds = range_audit.run_pass()
+    elapsed = time.monotonic() - t0
+    assert finds == [], "\n".join(
+        f"{f.rule} {f.path}: {f.message}" for f in finds)
+    assert elapsed < 60.0, f"range pass took {elapsed:.1f}s (budget 60s)"
+
+
+def test_golden_pins_every_audited_tier_with_horizons():
+    with open(range_audit.golden_path()) as fh:
+        golden = json.load(fh)
+    assert set(golden["tiers"]) == set(jaxpr_audit.AUDIT_CONFIGS)
+    assert golden["soak_ticks"] == range_audit.SOAK_TICKS
+    # config5c's pack widths ride the golden (the compact-plane contract).
+    assert golden["tiers"]["config5c"]["pack_widths"] == {
+        leg: list(w)
+        for leg, w in tile.pack_width_table(PRESETS["config5c"][0]).items()}
+    # Every monotone protocol leg's pinned horizon clears the soak budget.
+    for name, tier in golden["tiers"].items():
+        for leg, ent in tier["legs"].items():
+            if ent.get("horizon") is not None and range_audit._protocol_leg(leg):
+                assert ent["horizon"] >= range_audit.SOAK_TICKS, (name, leg)

@@ -1,6 +1,6 @@
 """Static-analysis gate: run the raft_sim_tpu invariant auditor.
 
-Four passes (raft_sim_tpu/analysis): Pass A lowers the real step/scan
+Five passes (raft_sim_tpu/analysis): Pass A lowers the real step/scan
 programs per config tier and audits the jaxprs (dtype discipline,
 loop-invariant carry, recompile forks); Pass B lints the package source
 (traced branches, float literals) and cross-checks the types.py dtype
@@ -10,10 +10,14 @@ entry-point donation, roofline at the pinned HBM rate) against the pins in
 tests/golden_cost_model.json; Pass D audits host<->device concurrency
 (use-after-donate dataflow over the standing loops, overlap write-set
 disjointness, PRNG key-stream and single-writer sink discipline), with an
-optional runtime donation-poison leg (--dynamic). Lowering only -- no device
-execution, and the only XLA compiles are tiny-shape donation probes (plus
-the short sanitizer sessions when --dynamic is given) -- so the whole gate
-runs in well under a minute on CPU. CI runs it before the tier-1 tests.
+optional runtime donation-poison leg (--dynamic); Pass E abstract-interprets
+the same lowered jaxprs over integer intervals (overflow on narrowing
+casts, pack-width fit, gather/scatter index bounds, stale range comments,
+safe soak horizons) against the pins in tests/golden_ranges.json. Lowering
+only -- no device execution, and the only XLA compiles are tiny-shape
+donation probes (plus the short sanitizer sessions when --dynamic is given)
+-- so the whole gate runs in well under a minute on CPU. CI runs it before
+the tier-1 tests.
 
     python tools/check.py --all                  # all passes, text report
     python tools/check.py --all --format=json    # machine-readable (CI artifact)
@@ -22,8 +26,11 @@ runs in well under a minute on CPU. CI runs it before the tier-1 tests.
     python tools/check.py --cost                 # Pass C (cost model) only
     python tools/check.py --race                 # Pass D (concurrency) only
     python tools/check.py --race --dynamic       # + runtime donation poison
+    python tools/check.py --range                # Pass E (value ranges) only
     python tools/check.py --cost-diff            # pinned-vs-current cost table
-    python tools/check.py --update-goldens       # re-pin tests/golden_cost_model.json
+    python tools/check.py --range-diff           # pinned-vs-current range table
+    python tools/check.py --update-goldens       # re-pin golden_cost_model.json
+                                                 #   + golden_ranges.json
 
 Exit codes: 0 = no unwaived findings, 1 = unwaived findings (or a stale /
 malformed waiver file), 2 = usage error. Intentional exceptions live in
@@ -55,6 +62,12 @@ def main(argv=None) -> int:
              "discipline)",
     )
     ap.add_argument(
+        "--range", action="store_true", dest="range_",
+        help="Pass E only (value-range abstract interpretation: narrowing "
+             "overflow, pack-width fit, index bounds, annotation drift, "
+             "safe soak horizons vs tests/golden_ranges.json)",
+    )
+    ap.add_argument(
         "--dynamic", action="store_true",
         help="with the race pass: also run the runtime donation-poison "
              "sanitizer (short sanitizer-armed standing-loop sessions, "
@@ -75,8 +88,9 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--update-goldens", action="store_true",
-        help="regenerate tests/golden_cost_model.json from the current tree "
-             "(the cost-model pins; mirrors tests/test_golden_jaxpr.py "
+        help="regenerate tests/golden_cost_model.json AND "
+             "tests/golden_ranges.json from the current tree (the cost-model "
+             "and value-range pins; mirrors tests/test_golden_jaxpr.py "
              "--update) and exit",
     )
     ap.add_argument(
@@ -85,13 +99,25 @@ def main(argv=None) -> int:
              "donation) and exit 0 -- the CI failure-triage rendering",
     )
     ap.add_argument(
+        "--range-diff", action="store_true",
+        help="print the pinned-vs-current value-range table (carry "
+             "intervals, safe horizons, pack widths) and exit 0 -- the CI "
+             "failure-triage rendering",
+    )
+    ap.add_argument(
         "--cost-report", default=None, metavar="PATH",
         help="also write the full derived cost document (per-leg carry "
              "model, donation audit, rooflines) as JSON to PATH",
     )
+    ap.add_argument(
+        "--range-report", default=None, metavar="PATH",
+        help="also write the full derived range document (per-leg carry "
+             "intervals, escapes, horizons, pack widths, ceilings) as JSON "
+             "to PATH",
+    )
     args = ap.parse_args(argv)
 
-    from raft_sim_tpu.analysis import cost_model, jaxpr_audit, run
+    from raft_sim_tpu.analysis import cost_model, jaxpr_audit, range_audit, run
     from raft_sim_tpu.analysis import findings as F
     from raft_sim_tpu.utils.config import PRESETS
 
@@ -109,9 +135,11 @@ def main(argv=None) -> int:
             # pins always cover every audited tier.
             print("--update-goldens ignores --configs: the golden file pins "
                   "ALL audited tiers", file=sys.stderr)
-        path = cost_model.update_golden()
-        print(f"wrote {path} (jax {__import__('jax').__version__}); review "
-              "the diff and commit it alongside the change it pins")
+        paths = [cost_model.update_golden(), range_audit.update_golden()]
+        for path in paths:
+            print(f"wrote {path} (jax {__import__('jax').__version__})")
+        print("review the diff and commit the files alongside the change "
+              "they pin")
         return 0
 
     if args.cost_diff:
@@ -125,11 +153,23 @@ def main(argv=None) -> int:
         cost_model.diff_table(derived, golden)
         return 0
 
-    picked = args.ast or args.jaxpr or args.cost or args.race
+    if args.range_diff:
+        derived, _finds = range_audit.derive_all(config_names)
+        try:
+            with open(range_audit.golden_path()) as f:
+                golden = json.load(f)
+        except (OSError, json.JSONDecodeError) as ex:
+            print(f"golden range file unreadable: {ex}", file=sys.stderr)
+            golden = {}
+        range_audit.diff_table(derived, golden)
+        return 0
+
+    picked = args.ast or args.jaxpr or args.cost or args.race or args.range_
     do_ast = args.all or args.ast or not picked
     do_jaxpr = args.all or args.jaxpr or not picked
     do_cost = args.all or args.cost or not picked
     do_race = args.all or args.race or not picked
+    do_range = args.all or args.range_ or not picked
     waivers_path = run.DEFAULT_WAIVERS
     if args.waivers:
         waivers_path = None if args.waivers == "none" else args.waivers
@@ -141,8 +181,8 @@ def main(argv=None) -> int:
     t0 = time.time()
     found, unused, problems, timings = run.run_all(
         do_ast=do_ast, do_jaxpr=do_jaxpr, do_cost=do_cost, do_race=do_race,
-        do_dynamic=args.dynamic, config_names=config_names,
-        waivers_path=waivers_path,
+        do_range=do_range, do_dynamic=args.dynamic,
+        config_names=config_names, waivers_path=waivers_path,
     )
     elapsed = time.time() - t0
     unwaived = [f for f in found if not f.waived]
@@ -155,6 +195,15 @@ def main(argv=None) -> int:
     elif args.cost_report:
         print("--cost-report ignored: the cost pass is not selected (add "
               "--cost or --all)", file=sys.stderr)
+
+    if args.range_report and do_range:
+        derived, _finds = range_audit.derive_all(config_names)
+        with open(args.range_report, "w") as f:
+            json.dump(derived, f, indent=1, sort_keys=True)
+            f.write("\n")
+    elif args.range_report:
+        print("--range-report ignored: the range pass is not selected (add "
+              "--range or --all)", file=sys.stderr)
 
     if args.format == "json":
         doc = F.report(
